@@ -1,0 +1,50 @@
+"""paddle_tpu — a TPU-native deep-learning framework with the capabilities
+of PaddlePaddle Fluid 1.3 (reference at /root/reference; blueprint in
+SURVEY.md).
+
+Public surface mirrors ``paddle.fluid``:
+
+    import paddle_tpu as fluid
+    x = fluid.layers.data("x", shape=[784])
+    y = fluid.layers.fc(x, size=10, act="softmax")
+    ...
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    exe.run(fluid.default_startup_program())
+    loss_val, = exe.run(feed={...}, fetch_list=[loss])
+
+Execution model: programs are symbolic op graphs compiled by whole-program
+``jax.jit`` into single XLA computations with donated state (see
+``core/executor.py``); parallelism is mesh sharding (see ``parallel/``).
+"""
+
+from .core import framework
+from .core.framework import (  # noqa: F401
+    Program, Variable, Parameter,
+    default_main_program, default_startup_program, program_guard,
+    name_scope)
+from .core.executor import (  # noqa: F401
+    Executor, Scope, global_scope, scope_guard,
+    XLAPlace, TPUPlace, CPUPlace, CUDAPlace)
+from .core.compiler import (  # noqa: F401
+    CompiledProgram, BuildStrategy, ExecutionStrategy)
+from .core.param_attr import ParamAttr  # noqa: F401
+from .core import initializer  # noqa: F401
+from .core import unique_name  # noqa: F401
+
+from . import layers  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import backward  # noqa: F401
+from .backward import append_backward, calc_gradient, gradients  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import clip  # noqa: F401
+from . import metrics  # noqa: F401
+from . import io  # noqa: F401
+from . import dygraph  # noqa: F401
+from . import data  # noqa: F401
+from .data.feeder import DataFeeder  # noqa: F401
+from . import profiler  # noqa: F401
+from . import parallel  # noqa: F401
+from .version import __version__  # noqa: F401
+
+# convenience re-exports matching fluid's top level
+from .clip import set_gradient_clip  # noqa: F401

@@ -1,0 +1,104 @@
+"""Op registry: symbolic op type -> pure jax execution function.
+
+The reference registers C++ kernels per (place, dtype, layout, library)
+(``paddle/fluid/framework/op_registry.h:197,237,240``) and dispatches at
+runtime per op (``operator.h:449``). Here every op type maps to ONE pure jax
+function ``impl(env, op)`` that reads input arrays from ``env`` (a dict of
+name -> jax array built during tracing) and writes outputs back. The entire
+op list is traced into a single XLA computation, so "kernel dispatch" and
+"fusion passes" are both delegated to XLA — the TPU-idiomatic equivalent of
+the reference's per-op kernel launch + ir fuse passes.
+"""
+
+import jax
+import jax.numpy as jnp
+
+OP_IMPLS = {}
+
+# rng key threading: reserved env entries
+RNG_KEY = "@RNG@"
+RNG0_KEY = "@RNG0@"  # snapshot at step start, used for autodiff replay
+
+
+def register(*names):
+    """Decorator: register an impl under one or more op type names."""
+
+    def deco(fn):
+        for n in names:
+            if n in OP_IMPLS:
+                raise ValueError("op %s registered twice" % n)
+            OP_IMPLS[n] = fn
+        return fn
+
+    return deco
+
+
+def registered(name):
+    return name in OP_IMPLS
+
+
+def run_op(env, op):
+    impl = OP_IMPLS.get(op.type)
+    if impl is None:
+        raise NotImplementedError(
+            "no TPU impl registered for op type '%s' (inputs=%s)"
+            % (op.type, op.input_arg_names)
+        )
+    cond_name = op.attrs.get("_switch_cond")
+    old = None
+    if cond_name is not None:
+        old = {n: env[n] for n in op.output_arg_names if n in env}
+    with jax.named_scope(op.type):
+        impl(env, op)
+    if cond_name is not None:
+        # Switch-case guard: keep prior value where the case doesn't fire
+        pred = env[cond_name].reshape(())
+        import jax.numpy as jnp
+
+        for n in op.output_arg_names:
+            if n in old:
+                env[n] = jnp.where(pred, env[n], old[n])
+
+
+def get(env, var):
+    if var is None:
+        return None
+    try:
+        return env[var.name]
+    except KeyError:
+        raise KeyError(
+            "op input '%s' not materialized; feed it or run the startup "
+            "program first" % var.name
+        )
+
+
+def get_list(env, op, slot):
+    return [get(env, v) for v in op.input_list(slot)]
+
+
+def put(env, var, val):
+    if var is not None:
+        env[var.name] = val
+
+
+def next_rng(env):
+    """Split the threaded PRNG key (functional randomness under jit)."""
+    key, sub = jax.random.split(env[RNG_KEY])
+    env[RNG_KEY] = key
+    return sub
+
+
+def bcast_y(x, y, axis):
+    """Reference elementwise broadcast semantics: y's shape aligns to x
+    starting at ``axis`` (ref ``operators/elementwise/elementwise_op.h``).
+    axis=-1 means align trailing dims (numpy broadcasting)."""
+    if axis is None:
+        axis = -1
+    if x.ndim == y.ndim or y.ndim == 0:
+        return y
+    if axis == -1:
+        axis = x.ndim - y.ndim
+    new_shape = [1] * x.ndim
+    for i, s in enumerate(y.shape):
+        new_shape[axis + i] = s
+    return jnp.reshape(y, new_shape)

@@ -1,0 +1,261 @@
+"""Autodiff + control-flow + LR-schedule ops.
+
+``autodiff`` is the TPU-native replacement for the reference's
+source-to-source backward pass (``python/paddle/fluid/backward.py:394``
+``append_backward``, which emits per-op grad OpDescs via C++ GradOpMakers):
+here a single symbolic op re-traces the forward slice under ``jax.grad``.
+Because the executor traces the whole program into one jit, XLA CSEs the
+replayed forward against the already-traced forward — zero duplicate compute,
+and the backward is scheduled/fused globally by XLA instead of op-by-op.
+
+Control flow: ``cond_block`` / ``while_block`` lower sub-block bodies to
+``lax.cond`` / ``lax.while_loop`` (ref ``conditional_block_op.cc`` /
+``while_op.cc`` interpret sub-BlockDescs).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put, run_op, RNG_KEY, RNG0_KEY
+
+
+@register("autodiff")
+def _autodiff(env, op):
+    fwd_ops = op.attr("fwd_ops")
+    wrt_names = op.attr("wrt_names")
+    loss_var = op.input("Loss")
+    rng0 = env.get(RNG0_KEY)
+
+    def loss_fn(wrt_vals):
+        local = dict(env)
+        local.update(wrt_vals)
+        if rng0 is not None:
+            local[RNG_KEY] = rng0
+        for f in fwd_ops:
+            run_op(local, f)
+        return jnp.sum(local[loss_var.name])
+
+    if op.attr("remat"):
+        # coarse rematerialization (≡ reference memory_optimize pass):
+        # recompute forward activations in the backward instead of saving
+        loss_fn = jax.checkpoint(loss_fn)
+
+    wrt_vals = {n: env[n] for n in wrt_names}
+    grads = jax.grad(loss_fn)(wrt_vals)
+    out_vars = op.output_list("Grads")
+    assert len(out_vars) == len(wrt_names)
+    for name, v in zip(wrt_names, out_vars):
+        g = grads[name]
+        callback = op.attr("grad_callback")
+        if callback is not None:
+            g = callback(name, g)
+        put(env, v, g)
+
+
+@register("autodiff_vjp")
+def _autodiff_vjp(env, op):
+    """calc_gradient: vjp of arbitrary targets w.r.t. arbitrary inputs."""
+    fwd_ops = op.attr("fwd_ops")
+    wrt_names = op.attr("wrt_names")
+    targets = op.input_list("Targets")
+    tgs = op.input_list("TargetGrads")
+    rng0 = env.get(RNG0_KEY)
+
+    def f(wrt_vals):
+        local = dict(env)
+        local.update(wrt_vals)
+        if rng0 is not None:
+            local[RNG_KEY] = rng0
+        for fo in fwd_ops:
+            run_op(local, fo)
+        return tuple(local[t.name] for t in targets)
+
+    primals, vjp_fn = jax.vjp(f, {n: env[n] for n in wrt_names})
+    if tgs:
+        cot = tuple(get(env, t) for t in tgs)
+    else:
+        cot = tuple(jnp.ones_like(p) for p in primals)
+    (grads,) = vjp_fn(cot)
+    for name, v in zip(wrt_names, op.output_list("Grads")):
+        put(env, v, grads[name])
+
+
+@register("cond_block")
+def _cond_block(env, op):
+    """lax.cond over two traced sub-blocks. Output vars are merged from the
+    branch results (both branches must produce all outputs)."""
+    pred = get(env, op.input("Cond")).reshape(())
+    true_ops = op.attr("true_ops")
+    false_ops = op.attr("false_ops")
+    true_names = op.attr("true_out_names") or [v.name for v in op.output_list("Out")]
+    false_names = op.attr("false_out_names") or true_names
+
+    def run_branch(ops, names):
+        def fn(_):
+            local = dict(env)
+            for o in ops:
+                run_op(local, o)
+            return tuple(local[n] for n in names)
+        return fn
+
+    outs = jax.lax.cond(pred, run_branch(true_ops, true_names),
+                        run_branch(false_ops, false_names), None)
+    for v, o in zip(op.output_list("Out"), outs):
+        put(env, v, o)
+
+
+@register("while_block")
+def _while_block(env, op):
+    """lax.while_loop over a sub-block body. Carry = the loop vars listed in
+    the op's ``Carry`` slot; the condition reads carry[0] (a bool scalar
+    recomputed by the body), matching the reference while_op's contract of a
+    boolean condition var."""
+    body_ops = op.attr("body_ops")
+    cond_name = op.attr("cond_name")
+    carry_vars = op.input_list("Carry")
+    carry_names = [v.name for v in carry_vars]
+
+    def cond_fn(carry):
+        return carry[0].reshape(()).astype(bool)
+
+    def body_fn(carry):
+        local = dict(env)
+        local.update({n: c for n, c in zip([cond_name] + carry_names, carry)})
+        for o in body_ops:
+            run_op(local, o)
+        return tuple([local[cond_name]] + [local[n] for n in carry_names])
+
+    init = tuple([env[cond_name]] + [env[n] for n in carry_names])
+    final = jax.lax.while_loop(cond_fn, body_fn, init)
+    for v, val in zip(op.output_list("Out"), final[1:]):
+        put(env, v, val)
+
+
+@register("scan_block")
+def _scan_block(env, op):
+    """lax.scan over a traced step (used by StaticRNN): xs are [T, ...]
+    stacked inputs, carry vars persist across steps, ys are stacked outputs.
+    TPU-idiomatic replacement for the reference ``recurrent_op.cc``."""
+    step_ops = op.attr("step_ops")
+    x_vars = op.input_list("X")          # scanned inputs (leading time axis)
+    init_vars = op.input_list("Init")    # carry inits
+    x_names = op.attr("x_step_names")    # names the step body reads per-step
+    carry_names = op.attr("carry_names")  # names read (pre) & written (post)
+    carry_out_names = op.attr("carry_out_names")
+    y_names = op.attr("y_names")         # per-step outputs to stack
+
+    def step(carry, xs_t):
+        local = dict(env)
+        local.update({n: c for n, c in zip(carry_names, carry)})
+        local.update({n: x for n, x in zip(x_names, xs_t)})
+        for o in step_ops:
+            run_op(local, o)
+        new_carry = tuple(local[n] for n in carry_out_names)
+        ys = tuple(local[n] for n in y_names)
+        return new_carry, ys
+
+    init = tuple(get(env, v) for v in init_vars)
+    xs = tuple(get(env, v) for v in x_vars)
+    final_carry, ys = jax.lax.scan(step, init, xs)
+    for v, val in zip(op.output_list("Last"), final_carry):
+        put(env, v, val)
+    for v, val in zip(op.output_list("Ys"), ys):
+        put(env, v, val)
+
+
+# ---------------- learning-rate schedule ops ----------------
+# The reference builds these from counter vars + math ops appended by
+# ``layers/learning_rate_scheduler.py``; here each schedule is one fused op
+# reading the global step counter (a persistable state var).
+
+@register("lr_exponential_decay")
+def _lr_exp_decay(env, op):
+    step = get(env, op.input("Step")).reshape(()).astype(jnp.float32)
+    lr0 = op.attr("learning_rate")
+    decay_steps = op.attr("decay_steps")
+    decay_rate = op.attr("decay_rate")
+    div = step / decay_steps
+    if op.attr("staircase", False):
+        div = jnp.floor(div)
+    put(env, op.output("Out"), (lr0 * jnp.power(decay_rate, div)).reshape(()))
+
+
+@register("lr_natural_exp_decay")
+def _lr_natural_exp(env, op):
+    step = get(env, op.input("Step")).reshape(()).astype(jnp.float32)
+    lr0 = op.attr("learning_rate")
+    decay_steps = op.attr("decay_steps")
+    decay_rate = op.attr("decay_rate")
+    div = step / decay_steps
+    if op.attr("staircase", False):
+        div = jnp.floor(div)
+    put(env, op.output("Out"), (lr0 * jnp.exp(-decay_rate * div)).reshape(()))
+
+
+@register("lr_inverse_time_decay")
+def _lr_inverse_time(env, op):
+    step = get(env, op.input("Step")).reshape(()).astype(jnp.float32)
+    lr0 = op.attr("learning_rate")
+    decay_steps = op.attr("decay_steps")
+    decay_rate = op.attr("decay_rate")
+    div = step / decay_steps
+    if op.attr("staircase", False):
+        div = jnp.floor(div)
+    put(env, op.output("Out"), (lr0 / (1.0 + decay_rate * div)).reshape(()))
+
+
+@register("lr_polynomial_decay")
+def _lr_polynomial(env, op):
+    step = get(env, op.input("Step")).reshape(()).astype(jnp.float32)
+    lr0 = op.attr("learning_rate")
+    decay_steps = op.attr("decay_steps")
+    end_lr = op.attr("end_learning_rate", 1e-4)
+    power = op.attr("power", 1.0)
+    if op.attr("cycle", False):
+        div = jnp.ceil(jnp.maximum(step / decay_steps, 1.0))
+        decay = decay_steps * div
+    else:
+        decay = decay_steps
+        step = jnp.minimum(step, decay_steps)
+    out = (lr0 - end_lr) * jnp.power(1 - step / decay, power) + end_lr
+    put(env, op.output("Out"), out.reshape(()))
+
+
+@register("lr_piecewise_decay")
+def _lr_piecewise(env, op):
+    step = get(env, op.input("Step")).reshape(()).astype(jnp.float32)
+    boundaries = jnp.asarray(op.attr("boundaries"), dtype=jnp.float32)
+    values = jnp.asarray(op.attr("values"), dtype=jnp.float32)
+    idx = jnp.searchsorted(boundaries, step, side="right")
+    put(env, op.output("Out"), values[idx].reshape(()))
+
+
+@register("lr_cosine_decay")
+def _lr_cosine(env, op):
+    step = get(env, op.input("Step")).reshape(()).astype(jnp.float32)
+    lr0 = op.attr("learning_rate")
+    step_each_epoch = op.attr("step_each_epoch")
+    epochs = op.attr("epochs")
+    cur_epoch = jnp.floor(step / step_each_epoch)
+    out = lr0 * 0.5 * (jnp.cos(cur_epoch * jnp.pi / epochs) + 1)
+    put(env, op.output("Out"), out.reshape(()))
+
+
+@register("lr_noam_decay")
+def _lr_noam(env, op):
+    step = jnp.maximum(get(env, op.input("Step")).reshape(()).astype(jnp.float32), 1.0)
+    d_model = op.attr("d_model")
+    warmup = op.attr("warmup_steps")
+    out = d_model ** -0.5 * jnp.minimum(step ** -0.5, step * warmup ** -1.5)
+    put(env, op.output("Out"), out.reshape(()))
+
+
+@register("lr_linear_warmup")
+def _lr_linear_warmup(env, op):
+    step = get(env, op.input("Step")).reshape(()).astype(jnp.float32)
+    base = get(env, op.input("Base")).reshape(())
+    warmup = op.attr("warmup_steps")
+    start_lr = op.attr("start_lr")
+    end_lr = op.attr("end_lr")
+    warm = start_lr + (end_lr - start_lr) * step / warmup
+    put(env, op.output("Out"), jnp.where(step < warmup, warm, base).reshape(()))

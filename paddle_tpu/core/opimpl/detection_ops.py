@@ -1,0 +1,202 @@
+"""Detection ops (subset; ref ``paddle/fluid/operators/detection/``).
+
+Static-shape friendly members implemented for round 1: prior_box,
+box_coder, iou_similarity, roi_pool/align on fixed ROI counts. NMS-style
+dynamic-output ops are provided with fixed-size outputs + validity masks
+(XLA cannot produce data-dependent shapes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..op_registry import register, get, put
+
+
+@register("iou_similarity")
+def _iou_similarity(env, op):
+    x = get(env, op.input("X"))  # [N, 4] xmin ymin xmax ymax
+    y = get(env, op.input("Y"))  # [M, 4]
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    put(env, op.output("Out"), inter / jnp.maximum(union, 1e-10))
+
+
+@register("box_coder")
+def _box_coder(env, op):
+    prior = get(env, op.input("PriorBox"))  # [M, 4]
+    pvar = get(env, op.input("PriorBoxVar"))
+    target = get(env, op.input("TargetBox"))
+    code_type = op.attr("code_type", "encode_center_size")
+    norm = op.attr("box_normalized", True)
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is None:
+        pvar = jnp.ones((4,), prior.dtype)
+    if pvar.ndim == 2:
+        v0, v1, v2, v3 = pvar[:, 0], pvar[:, 1], pvar[:, 2], pvar[:, 3]
+    else:
+        v0, v1, v2, v3 = pvar[0], pvar[1], pvar[2], pvar[3]
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / v0
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / v1
+        ow = jnp.log(tw[:, None] / pw[None, :]) / v2
+        oh = jnp.log(th[:, None] / ph[None, :]) / v3
+        put(env, op.output("OutputBox"), jnp.stack([ox, oy, ow, oh], axis=-1))
+    else:  # decode_center_size; target [N, M, 4]
+        ox = v0 * target[..., 0] * pw + pcx
+        oy = v1 * target[..., 1] * ph + pcy
+        ow = jnp.exp(v2 * target[..., 2]) * pw
+        oh = jnp.exp(v3 * target[..., 3]) * ph
+        out = jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                         ox + ow * 0.5 - one, oy + oh * 0.5 - one], axis=-1)
+        put(env, op.output("OutputBox"), out)
+
+
+@register("prior_box")
+def _prior_box(env, op):
+    feat = get(env, op.input("Input"))  # NCHW feature map
+    img = get(env, op.input("Image"))
+    min_sizes = op.attr("min_sizes")
+    max_sizes = op.attr("max_sizes", [])
+    ratios = op.attr("aspect_ratios", [1.0])
+    flip = op.attr("flip", False)
+    clip = op.attr("clip", False)
+    step_w = op.attr("step_w", 0.0)
+    step_h = op.attr("step_h", 0.0)
+    offset = op.attr("offset", 0.5)
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    sw = step_w or img_w / w
+    sh = step_h or img_h / h
+    ars = [1.0]
+    for r in ratios:
+        if all(abs(r - a) > 1e-6 for a in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * np.sqrt(ar) * 0.5
+            bh = ms / np.sqrt(ar) * 0.5
+            boxes.append((bw, bh))
+        if max_sizes:
+            for mxs in max_sizes:
+                s = np.sqrt(ms * mxs) * 0.5
+                boxes.append((s, s))
+    cx = (jnp.arange(w) + offset) * sw
+    cy = (jnp.arange(h) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    all_boxes = []
+    for bw, bh in boxes:
+        b = jnp.stack([(cxg - bw) / img_w, (cyg - bh) / img_h,
+                       (cxg + bw) / img_w, (cyg + bh) / img_h], axis=-1)
+        all_boxes.append(b)
+    out = jnp.stack(all_boxes, axis=2)  # [H, W, num_priors, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    put(env, op.output("Boxes"), out)
+    put(env, op.output("Variances"), var)
+
+
+@register("roi_align")
+def _roi_align(env, op):
+    x = get(env, op.input("X"))  # [N, C, H, W]
+    rois = get(env, op.input("ROIs"))  # [R, 4] in image coords; batch 0 only
+    pooled_h = op.attr("pooled_height", 1)
+    pooled_w = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        ys = y1 + (jnp.arange(pooled_h) + 0.5) * rh / pooled_h
+        xs = x1 + (jnp.arange(pooled_w) + 0.5) * rw / pooled_w
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None]
+        wx = (xs - x0)[None, :]
+        img = x[0]
+        g = lambda yy, xx: img[:, yy][:, :, xx]
+        return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1i, x0) * wy * (1 - wx)
+                + g(y0, x1i) * (1 - wy) * wx + g(y1i, x1i) * wy * wx)
+
+    put(env, op.output("Out"), jax.vmap(one_roi)(rois))
+
+
+@register("roi_pool")
+def _roi_pool(env, op):
+    x = get(env, op.input("X"))
+    rois = get(env, op.input("ROIs"))
+    pooled_h = op.attr("pooled_height", 1)
+    pooled_w = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[0]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        outs = []
+        for ph in range(pooled_h):
+            for pw in range(pooled_w):
+                ys_lo = y1 + (ph * rh) // pooled_h
+                ys_hi = y1 + ((ph + 1) * rh + pooled_h - 1) // pooled_h
+                xs_lo = x1 + (pw * rw) // pooled_w
+                xs_hi = x1 + ((pw + 1) * rw + pooled_w - 1) // pooled_w
+                m = ((ys >= ys_lo) & (ys < jnp.maximum(ys_hi, ys_lo + 1)))[None, :, None] & \
+                    ((xs >= xs_lo) & (xs < jnp.maximum(xs_hi, xs_lo + 1)))[None, None, :]
+                outs.append(jnp.max(jnp.where(m, img, -jnp.inf), axis=(1, 2)))
+        return jnp.stack(outs, axis=-1).reshape(c, pooled_h, pooled_w)
+
+    put(env, op.output("Out"), jax.vmap(one_roi)(rois))
+
+
+@register("anchor_generator")
+def _anchor_generator(env, op):
+    feat = get(env, op.input("Input"))
+    sizes = op.attr("anchor_sizes")
+    ratios = op.attr("aspect_ratios")
+    stride = op.attr("stride")
+    offset = op.attr("offset", 0.5)
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = feat.shape[2], feat.shape[3]
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * np.sqrt(1.0 / r) * 0.5
+            ah = s * np.sqrt(r) * 0.5
+            anchors.append(jnp.stack(
+                [cxg - aw, cyg - ah, cxg + aw, cyg + ah], axis=-1))
+    out = jnp.stack(anchors, axis=2)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    put(env, op.output("Anchors"), out)
+    put(env, op.output("Variances"), var)

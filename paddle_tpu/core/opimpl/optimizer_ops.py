@@ -1,0 +1,235 @@
+"""Optimizer update ops.
+
+Reference: ``paddle/fluid/operators/optimizers/`` (sgd, momentum +
+lars_momentum, adam, adamax, adagrad, decayed_adagrad, adadelta, rmsprop,
+ftrl) — dense paths. Each op's "Out" slots alias the state var names, so the
+executor's state write-back gives in-place semantics; with buffer donation
+XLA updates parameters in place on device (the TPU equivalent of the
+reference's in-place ParamOut contract).
+
+Sparse (SelectedRows) gradient paths: gradients of ``lookup_table`` arrive
+dense from jax.grad but XLA lowers the gather-vjp to scatter-add; for truly
+sparse updates see ``paddle_tpu.parallel.sharded_embedding``.
+"""
+
+import jax.numpy as jnp
+import jax
+
+from ..op_registry import register, get, put
+
+
+def _lr(env, op):
+    lr = get(env, op.input("LearningRate"))
+    return lr.reshape(()) if lr.ndim else lr
+
+
+@register("sgd")
+def _sgd(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    put(env, op.output("ParamOut"), p - _lr(env, op) * g)
+
+
+@register("momentum")
+def _momentum(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    v = get(env, op.input("Velocity"))
+    mu = op.attr("mu")
+    lr = _lr(env, op)
+    v_new = mu * v + g
+    if op.attr("use_nesterov", False):
+        p_new = p - (g + mu * v_new) * lr
+    else:
+        p_new = p - lr * v_new
+    put(env, op.output("ParamOut"), p_new)
+    put(env, op.output("VelocityOut"), v_new)
+
+
+@register("lars_momentum")
+def _lars_momentum(env, op):
+    """LARS (ref ``lars_momentum_op.cc``): layer-wise adaptive LR."""
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    v = get(env, op.input("Velocity"))
+    mu = op.attr("mu")
+    lars_coeff = op.attr("lars_coeff", 0.001)
+    lars_wd = op.attr("lars_weight_decay", 0.0005)
+    lr = _lr(env, op)
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    local_lr = jnp.where(
+        (p_norm > 0) & (g_norm > 0),
+        lr * lars_coeff * p_norm / (g_norm + lars_wd * p_norm + 1e-12),
+        lr)
+    v_new = mu * v + local_lr * (g + lars_wd * p)
+    put(env, op.output("ParamOut"), p - v_new)
+    put(env, op.output("VelocityOut"), v_new)
+
+
+@register("adam")
+def _adam(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    m = get(env, op.input("Moment1"))
+    v = get(env, op.input("Moment2"))
+    b1p = get(env, op.input("Beta1Pow")).reshape(())
+    b2p = get(env, op.input("Beta2Pow")).reshape(())
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    lr = _lr(env, op)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    # ref adam_op.h: lr_t = lr * sqrt(1-beta2^t) / (1-beta1^t); the pow
+    # accumulators arrive already holding beta^t for the current step t
+    # (initialized to beta at t=1), so use them directly.
+    lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    p_new = p - lr_t * m_new / (jnp.sqrt(v_new) + eps)
+    put(env, op.output("ParamOut"), p_new)
+    put(env, op.output("Moment1Out"), m_new)
+    put(env, op.output("Moment2Out"), v_new)
+    put(env, op.output("Beta1PowOut"), (b1p * b1).reshape((1,)))
+    put(env, op.output("Beta2PowOut"), (b2p * b2).reshape((1,)))
+
+
+@register("adamax")
+def _adamax(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    m = get(env, op.input("Moment"))
+    inf_norm = get(env, op.input("InfNorm"))
+    b1p = get(env, op.input("Beta1Pow")).reshape(())
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-8)
+    lr = _lr(env, op)
+    m_new = b1 * m + (1 - b1) * g
+    inf_new = jnp.maximum(b2 * inf_norm, jnp.abs(g) + eps)
+    lr_t = lr / (1 - b1p)
+    put(env, op.output("ParamOut"), p - lr_t * m_new / inf_new)
+    put(env, op.output("MomentOut"), m_new)
+    put(env, op.output("InfNormOut"), inf_new)
+
+
+@register("adagrad")
+def _adagrad(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    mom = get(env, op.input("Moment"))
+    eps = op.attr("epsilon", 1e-6)
+    lr = _lr(env, op)
+    mom_new = mom + jnp.square(g)
+    put(env, op.output("ParamOut"), p - lr * g / (jnp.sqrt(mom_new) + eps))
+    put(env, op.output("MomentOut"), mom_new)
+
+
+@register("decayed_adagrad")
+def _decayed_adagrad(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    mom = get(env, op.input("Moment"))
+    decay = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    lr = _lr(env, op)
+    mom_new = decay * mom + (1 - decay) * jnp.square(g)
+    put(env, op.output("ParamOut"), p - lr * g / (jnp.sqrt(mom_new) + eps))
+    put(env, op.output("MomentOut"), mom_new)
+
+
+@register("adadelta")
+def _adadelta(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    avg_sq_g = get(env, op.input("AvgSquaredGrad"))
+    avg_sq_u = get(env, op.input("AvgSquaredUpdate"))
+    rho = op.attr("rho", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    g2 = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    upd = jnp.sqrt(avg_sq_u + eps) / jnp.sqrt(g2 + eps) * g
+    u2 = rho * avg_sq_u + (1 - rho) * jnp.square(upd)
+    put(env, op.output("ParamOut"), p - upd)
+    put(env, op.output("AvgSquaredGradOut"), g2)
+    put(env, op.output("AvgSquaredUpdateOut"), u2)
+
+
+@register("rmsprop")
+def _rmsprop(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    ms = get(env, op.input("MeanSquare"))
+    mg = get(env, op.input("MeanGrad"))
+    mom = get(env, op.input("Moment"))
+    rho = op.attr("decay", 0.95)
+    eps = op.attr("epsilon", 1e-6)
+    momentum = op.attr("momentum", 0.0)
+    centered = op.attr("centered", False)
+    lr = _lr(env, op)
+    ms_new = rho * ms + (1 - rho) * jnp.square(g)
+    if centered:
+        mg_new = rho * mg + (1 - rho) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+        put(env, op.output("MeanGradOut"), mg_new)
+    else:
+        denom = jnp.sqrt(ms_new + eps)
+    mom_new = momentum * mom + lr * g / denom
+    put(env, op.output("ParamOut"), p - mom_new)
+    put(env, op.output("MeanSquareOut"), ms_new)
+    put(env, op.output("MomentOut"), mom_new)
+
+
+@register("ftrl")
+def _ftrl(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    sq = get(env, op.input("SquaredAccumulator"))
+    lin = get(env, op.input("LinearAccumulator"))
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    lr_power = op.attr("lr_power", -0.5)
+    lr = _lr(env, op)
+    new_sq = sq + jnp.square(g)
+    if lr_power == -0.5:
+        sigma = (jnp.sqrt(new_sq) - jnp.sqrt(sq)) / lr
+    else:
+        sigma = (jnp.power(new_sq, -lr_power) - jnp.power(sq, -lr_power)) / lr
+    new_lin = lin + g - sigma * p
+    if lr_power == -0.5:
+        denom = jnp.sqrt(new_sq) / lr + 2 * l2
+    else:
+        denom = jnp.power(new_sq, -lr_power) / lr + 2 * l2
+    pre_shrink = (l1 * jnp.sign(new_lin) - new_lin) / denom
+    p_new = jnp.where(jnp.abs(new_lin) > l1, pre_shrink, jnp.zeros_like(p))
+    put(env, op.output("ParamOut"), p_new)
+    put(env, op.output("SquaredAccumOut"), new_sq)
+    put(env, op.output("LinearAccumOut"), new_lin)
+
+
+@register("lamb")
+def _lamb(env, op):
+    """LAMB optimizer — beyond the reference's 2019 set; standard for BERT
+    pretraining at scale on TPU pods."""
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    m = get(env, op.input("Moment1"))
+    v = get(env, op.input("Moment2"))
+    b1p = get(env, op.input("Beta1Pow")).reshape(())
+    b2p = get(env, op.input("Beta2Pow")).reshape(())
+    b1 = op.attr("beta1", 0.9)
+    b2 = op.attr("beta2", 0.999)
+    eps = op.attr("epsilon", 1e-6)
+    wd = op.attr("weight_decay", 0.01)
+    lr = _lr(env, op)
+    m_new = b1 * m + (1 - b1) * g
+    v_new = b2 * v + (1 - b2) * jnp.square(g)
+    m_hat = m_new / (1 - b1p)
+    v_hat = v_new / (1 - b2p)
+    r = m_hat / (jnp.sqrt(v_hat) + eps) + wd * p
+    p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+    r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+    trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+    put(env, op.output("ParamOut"), p - lr * trust * r)
+    put(env, op.output("Moment1Out"), m_new)
+    put(env, op.output("Moment2Out"), v_new)
+    put(env, op.output("Beta1PowOut"), (b1p * b1).reshape((1,)))
+    put(env, op.output("Beta2PowOut"), (b2p * b2).reshape((1,)))

@@ -1,0 +1,132 @@
+"""Recurrent ops: LSTM / GRU over padded batches with length masks.
+
+The reference handles variable-length sequences with LoD-packed batches and
+specialized kernels (``math/lstm_compute``, ``gru_op.cc``,
+``recurrent_op.cc``). On TPU the idiomatic form is static-shape padded
+[batch, time, ...] tensors + a length mask, scanned with ``lax.scan`` so XLA
+compiles ONE fused step function — the gate matmuls hit the MXU per step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put
+
+
+def _mask_from_lengths(lengths, t_steps, dtype):
+    # [B] -> [T, B, 1] validity mask
+    t = jnp.arange(t_steps)[:, None]
+    return (t < lengths[None, :]).astype(dtype)[..., None]
+
+
+@register("lstm_seq")
+def _lstm_seq(env, op):
+    """Single-layer LSTM over [B, T, D] input.
+
+    Inputs: Input [B,T,4H] (pre-projected gates, like ref ``lstm_op`` taking
+    x@W as input), Weight [H,4H] recurrent weights, Bias [4H] (+peephole
+    [7H] unsupported -> first 4H used), Lengths [B] optional.
+    Gate order follows the reference: i, f, c(hat), o
+    (``operators/math/detail/lstm_kernel.h``)."""
+    xproj = get(env, op.input("Input"))  # [B, T, 4H]
+    w = get(env, op.input("Weight"))  # [H, 4H]
+    bias = get(env, op.input("Bias"))  # [1, 4H] or [4H]
+    lengths = get(env, op.input("Lengths"))
+    b_sz, t_sz, four_h = xproj.shape
+    h_sz = four_h // 4
+    is_reverse = op.attr("is_reverse", False)
+    if bias is not None:
+        bias = bias.reshape(-1)[: 4 * h_sz]
+
+    xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, 4H]
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+    mask = None
+    if lengths is not None:
+        mask = _mask_from_lengths(lengths.reshape(-1), t_sz, xproj.dtype)
+        if is_reverse:
+            mask = jnp.flip(mask, axis=0)
+
+    h0 = jnp.zeros((b_sz, h_sz), xproj.dtype)
+    c0 = jnp.zeros((b_sz, h_sz), xproj.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + h_prev @ w
+        if bias is not None:
+            gates = gates + bias
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        if m_t is not None:
+            h = h * m_t + h_prev * (1 - m_t)
+            c = c * m_t + c_prev * (1 - m_t)
+        return (h, c), (h, c)
+
+    if mask is None:
+        (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, jnp.ones((t_sz, b_sz, 1), xproj.dtype)))
+    else:
+        (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, mask))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    put(env, op.output("Hidden"), jnp.swapaxes(hs, 0, 1))  # [B, T, H]
+    put(env, op.output("Cell"), jnp.swapaxes(cs, 0, 1))
+
+
+@register("gru_seq")
+def _gru_seq(env, op):
+    """Single-layer GRU over [B, T, 3H] pre-projected input (ref ``gru_op``).
+    Gate order: update u, reset r, candidate c (``math/detail/gru_kernel.h``).
+    """
+    xproj = get(env, op.input("Input"))  # [B, T, 3H]
+    w = get(env, op.input("Weight"))  # [H, 3H]: [:, :2H] gates, [:, 2H:] candidate
+    bias = get(env, op.input("Bias"))
+    lengths = get(env, op.input("Lengths"))
+    b_sz, t_sz, three_h = xproj.shape
+    h_sz = three_h // 3
+    is_reverse = op.attr("is_reverse", False)
+    origin_mode = op.attr("origin_mode", False)
+    if bias is not None:
+        bias = bias.reshape(-1)
+
+    xs = jnp.swapaxes(xproj, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+    if lengths is not None:
+        mask = _mask_from_lengths(lengths.reshape(-1), t_sz, xproj.dtype)
+        if is_reverse:
+            mask = jnp.flip(mask, axis=0)
+    else:
+        mask = jnp.ones((t_sz, b_sz, 1), xproj.dtype)
+
+    w_g = w[:, : 2 * h_sz]
+    w_c = w[:, 2 * h_sz:]
+    h0 = jnp.zeros((b_sz, h_sz), xproj.dtype)
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        xg = x_t[:, : 2 * h_sz]
+        xc = x_t[:, 2 * h_sz:]
+        if bias is not None:
+            xg = xg + bias[: 2 * h_sz]
+            xc = xc + bias[2 * h_sz:]
+        g = jax.nn.sigmoid(xg + h_prev @ w_g)
+        u, r = jnp.split(g, 2, axis=-1)
+        c = jnp.tanh(xc + (r * h_prev) @ w_c)
+        if origin_mode:
+            h = u * h_prev + (1 - u) * c
+        else:
+            h = (1 - u) * h_prev + u * c
+        h = h * m_t + h_prev * (1 - m_t)
+        return h, h
+
+    _, hs = jax.lax.scan(step, h0, (xs, mask))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+    put(env, op.output("Hidden"), jnp.swapaxes(hs, 0, 1))

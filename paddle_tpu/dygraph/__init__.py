@@ -1,0 +1,10 @@
+"""Imperative (dygraph) mode — ref ``python/paddle/fluid/imperative/``.
+
+Eager execution over jax arrays with a Layer/module system; ``to_variable``
+wraps arrays, autograd via jax transforms on ``Layer.__call__`` graphs.
+"""
+
+from . import base
+from .base import guard, to_variable, enabled  # noqa: F401
+from .layers import Layer  # noqa: F401
+from . import nn  # noqa: F401

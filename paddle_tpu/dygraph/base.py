@@ -1,0 +1,65 @@
+"""Dygraph base (ref ``python/paddle/fluid/imperative/base.py``: ``guard:29``,
+``to_variable:47``).
+
+Eager mode runs jnp ops directly; ``VarBase`` wraps an array. Autodiff is
+functional (``paddle_tpu.dygraph.grad`` / ``jit_train_step``) rather than a
+tape — the dygraph→XLA path jits the module's pure apply function.
+"""
+
+import contextlib
+
+import jax.numpy as jnp
+import numpy as np
+
+_dygraph_tracer = None
+
+
+def _in_dygraph_mode():
+    return _dygraph_tracer is not None
+
+
+def enabled():
+    return _in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    global _dygraph_tracer
+    prev = _dygraph_tracer
+    _dygraph_tracer = object()
+    try:
+        yield
+    finally:
+        _dygraph_tracer = prev
+
+
+class VarBase:
+    """Eager tensor (ref ``imperative/layer.h:113`` VarBase)."""
+
+    def __init__(self, value, stop_gradient=False, name=None):
+        self._value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def value(self):
+        return self._value
+
+    def __repr__(self):
+        return "VarBase(%s)" % (self._value,)
+
+
+def to_variable(value, block=None, name=None):
+    if isinstance(value, VarBase):
+        return value
+    return VarBase(np.asarray(value), name=name)

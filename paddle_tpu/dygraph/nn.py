@@ -1,0 +1,226 @@
+"""Dygraph layers (ref ``python/paddle/fluid/imperative/nn.py``: Conv2D,
+Pool2D, FC, BatchNorm, Embedding + extras needed by BERT)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.initializer import (ConstantInitializer, NormalInitializer,
+                                XavierInitializer)
+from .base import VarBase, to_variable
+from .layers import Layer
+
+__all__ = ["FC", "Linear", "Conv2D", "Pool2D", "BatchNorm", "Embedding",
+           "LayerNorm", "Dropout", "PRelu"]
+
+
+def _v(x):
+    return x.value() if isinstance(x, VarBase) else jnp.asarray(x)
+
+
+class FC(Layer):
+    def __init__(self, name_scope=None, size=None, input_dim=None,
+                 num_flatten_dims=1, act=None, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._act = act
+        self._input_dim = input_dim
+        self._w = None
+        self._b = None
+
+    def _build_once(self, input_dim):
+        self._w = self.create_parameter([input_dim, self._size])
+        self._b = self.create_parameter([self._size], is_bias=True)
+
+    def forward(self, x):
+        xv = _v(x)
+        lead = xv.shape[: self._num_flatten_dims]
+        xv2 = xv.reshape(int(jnp.prod(jnp.asarray(lead))) if lead else 1, -1) \
+            if xv.ndim != 2 else xv
+        import numpy as np
+        xv2 = xv.reshape(int(np.prod(lead)), -1)
+        if self._w is None:
+            self._build_once(xv2.shape[-1])
+        out = xv2 @ self._w.value() + self._b.value()
+        out = out.reshape(tuple(lead) + (self._size,))
+        if self._act:
+            out = getattr(jax.nn, self._act if self._act != "relu6"
+                          else "relu6")(out) if hasattr(jax.nn, self._act) \
+                else getattr(jnp, self._act)(out)
+        return VarBase(out)
+
+
+Linear = FC
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=3, stride=1, padding=0, dilation=1, groups=1,
+                 act=None, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        k = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size,) * 2
+        self._stride = stride if isinstance(stride, (list, tuple)) else (stride,) * 2
+        self._padding = padding if isinstance(padding, (list, tuple)) else (padding,) * 2
+        self._dilation = dilation if isinstance(dilation, (list, tuple)) else (dilation,) * 2
+        self._groups = groups
+        self._act = act
+        std = math.sqrt(2.0 / (k[0] * k[1] * num_channels))
+        self._filter = self.create_parameter(
+            [num_filters, num_channels // groups, k[0], k[1]],
+            initializer=NormalInitializer(0.0, std))
+        self._bias = self.create_parameter([num_filters], is_bias=True)
+
+    def forward(self, x):
+        xv = _v(x)
+        out = jax.lax.conv_general_dilated(
+            xv, self._filter.value(), window_strides=tuple(self._stride),
+            padding=[(self._padding[0], self._padding[0]),
+                     (self._padding[1], self._padding[1])],
+            rhs_dilation=tuple(self._dilation),
+            feature_group_count=self._groups,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        out = out + self._bias.value().reshape(1, -1, 1, 1)
+        if self._act == "relu":
+            out = jax.nn.relu(out)
+        return VarBase(out)
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=2, pool_type="max",
+                 pool_stride=2, pool_padding=0, global_pooling=False,
+                 dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        self._size = pool_size if isinstance(pool_size, (list, tuple)) else (pool_size,) * 2
+        self._stride = pool_stride if isinstance(pool_stride, (list, tuple)) else (pool_stride,) * 2
+        self._padding = pool_padding if isinstance(pool_padding, (list, tuple)) else (pool_padding,) * 2
+        self._type = pool_type
+        self._global = global_pooling
+
+    def forward(self, x):
+        xv = _v(x)
+        if self._global:
+            red = jnp.max if self._type == "max" else jnp.mean
+            return VarBase(red(xv, axis=(2, 3), keepdims=True))
+        window = (1, 1) + tuple(self._size)
+        stride = (1, 1) + tuple(self._stride)
+        pads = [(0, 0), (0, 0),
+                (self._padding[0], self._padding[0]),
+                (self._padding[1], self._padding[1])]
+        if self._type == "max":
+            out = jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max, window,
+                                        stride, pads)
+        else:
+            s = jax.lax.reduce_window(xv, 0.0, jax.lax.add, window, stride,
+                                      pads)
+            out = s / (self._size[0] * self._size[1])
+        return VarBase(out)
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None,
+                 momentum=0.9, epsilon=1e-5, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        c = num_channels
+        self._scale = self.create_parameter(
+            [c], initializer=ConstantInitializer(1.0))
+        self._bias = self.create_parameter([c], is_bias=True)
+        self._mean = VarBase(jnp.zeros((c,)), stop_gradient=True,
+                             name=self._full_name + ".mean")
+        self._var = VarBase(jnp.ones((c,)), stop_gradient=True,
+                            name=self._full_name + ".var")
+        self._momentum = momentum
+        self._eps = epsilon
+        self._act = act
+
+    def forward(self, x):
+        xv = _v(x)
+        cshape = (1, -1) + (1,) * (xv.ndim - 2)
+        if self.training:
+            axes = tuple(i for i in range(xv.ndim) if i != 1)
+            mu = jnp.mean(xv, axis=axes)
+            var = jnp.var(xv, axis=axes)
+            self._mean._value = (self._momentum * self._mean.value()
+                                 + (1 - self._momentum) * jax.lax.stop_gradient(mu))
+            self._var._value = (self._momentum * self._var.value()
+                                + (1 - self._momentum) * jax.lax.stop_gradient(var))
+        else:
+            mu, var = self._mean.value(), self._var.value()
+        out = (xv - mu.reshape(cshape)) * jax.lax.rsqrt(
+            var.reshape(cshape) + self._eps)
+        out = out * self._scale.value().reshape(cshape) \
+            + self._bias.value().reshape(cshape)
+        if self._act == "relu":
+            out = jax.nn.relu(out)
+        return VarBase(out)
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, normalized_shape=None, epsilon=1e-5,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._shape = list(normalized_shape)
+        self._scale = self.create_parameter(
+            self._shape, initializer=ConstantInitializer(1.0))
+        self._bias = self.create_parameter(self._shape, is_bias=True)
+        self._eps = epsilon
+
+    def forward(self, x):
+        xv = _v(x)
+        axes = tuple(range(xv.ndim - len(self._shape), xv.ndim))
+        mu = jnp.mean(xv, axis=axes, keepdims=True)
+        var = jnp.var(xv, axis=axes, keepdims=True)
+        out = (xv - mu) * jax.lax.rsqrt(var + self._eps)
+        out = out * self._scale.value() + self._bias.value()
+        return VarBase(out)
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 padding_idx=None, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._padding_idx = padding_idx
+        self._w = self.create_parameter(
+            list(size), initializer=XavierInitializer())
+
+    def forward(self, ids):
+        iv = _v(ids).astype(jnp.int32)
+        if iv.ndim >= 2 and iv.shape[-1] == 1:
+            iv = iv.squeeze(-1)
+        out = jnp.take(self._w.value(), iv, axis=0)
+        if self._padding_idx is not None:
+            out = out * (iv != self._padding_idx)[..., None].astype(out.dtype)
+        return VarBase(out)
+
+
+class Dropout(Layer):
+    _key = jax.random.PRNGKey(1234)
+
+    def __init__(self, name_scope=None, p=0.5):
+        super().__init__(name_scope)
+        self._p = p
+
+    def forward(self, x):
+        xv = _v(x)
+        if not self.training or self._p == 0.0:
+            return VarBase(xv)
+        Dropout._key, sub = jax.random.split(Dropout._key)
+        keep = jax.random.bernoulli(sub, 1.0 - self._p, xv.shape)
+        return VarBase(xv * keep / (1.0 - self._p))
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope=None, mode="all", dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._alpha = self.create_parameter(
+            [1], initializer=ConstantInitializer(0.25))
+
+    def forward(self, x):
+        xv = _v(x)
+        a = self._alpha.value()
+        return VarBase(jnp.where(xv > 0, xv, a * xv))

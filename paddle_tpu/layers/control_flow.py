@@ -1,0 +1,297 @@
+"""Control-flow layers (ref ``python/paddle/fluid/layers/control_flow.py``:
+While:504, StaticRNN:278, ConditionalBlock:1055, Switch:1138).
+
+TPU-native lowering: sub-block bodies are recorded symbolically and executed
+through ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` — compiler-friendly
+control flow with static shapes, replacing the reference's interpreter
+recursion into sub-BlockDescs.
+"""
+
+from ..core import framework
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+
+__all__ = ["StaticRNN", "While", "Switch", "cond", "increment",
+           "less_than", "equal", "array_write", "array_read"]
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    """``cond`` (if given) receives the result in place — required inside a
+    While body so the loop condition var is actually updated (ref
+    ``layers/control_flow.py`` less_than cond semantics)."""
+    from .math_op_patch import binary
+    return binary(x, y, "less_than", out=cond)
+
+
+def equal(x, y, cond=None):
+    from .math_op_patch import binary
+    return binary(x, y, "equal", out=cond)
+
+
+def increment(x, value=1.0, in_place=True):
+    from . import tensor
+    return tensor.increment(x, value, in_place)
+
+
+class BlockGuard:
+    def __init__(self, program):
+        self.program = program
+
+    def __enter__(self):
+        self.block = self.program._create_block()
+        return self.block
+
+    def __exit__(self, *a):
+        self.program._rollback()
+        return False
+
+
+class StaticRNN:
+    """Static-length RNN (ref ``control_flow.py:278``): the step block is
+    recorded into a sub-block and lowered to one ``lax.scan`` — each step is
+    the fused step computation on the MXU.
+
+    Usage parity with the reference:
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)          # x: [B, T, D] (batch-major)
+            h = rnn.memory(shape=[H], batch_ref=x)
+            nh = some_layers(x_t, h)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()                           # [B, T, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._mems = []          # (pre_var, init_var)
+        self._mem_updates = {}   # pre_var.name -> post var
+        self._step_inputs = []   # (step_var, full_var)
+        self._step_outputs = []
+        self._block = None
+        self._entered = False
+
+    def step(self):
+        outer = self
+
+        class _Guard(BlockGuard):
+            def __init__(self):
+                super().__init__(framework.default_main_program())
+
+            def __enter__(self):
+                outer._block = super().__enter__()
+                outer._entered = True
+                return outer._block
+
+            def __exit__(self, *a):
+                outer._entered = False
+                return super().__exit__(*a)
+
+        return _Guard()
+
+    def step_input(self, x):
+        assert self._entered
+        step_var = self._block.create_var(
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=str(x.dtype))
+        self._step_inputs.append((step_var, x))
+        return step_var
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=0):
+        assert self._entered
+        if init is None:
+            from . import tensor
+            assert batch_ref is not None
+            # build init OUTSIDE the step block
+            cur = framework.default_main_program().current_block()
+            saved_idx = framework.default_main_program().current_block_idx
+            framework.default_main_program().current_block_idx = 0
+            init = tensor.fill_constant_batch_size_like(
+                batch_ref, [1] + list(shape), str(batch_ref.dtype), init_value)
+            framework.default_main_program().current_block_idx = saved_idx
+        pre = self._block.create_var(shape=init.shape, dtype=str(init.dtype))
+        self._mems.append((pre, init))
+        return pre
+
+    def update_memory(self, mem, var):
+        self._mem_updates[mem.name] = var
+
+    def step_output(self, o):
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self, *args, **kwargs):
+        prog = framework.default_main_program()
+        gb = prog.global_block()
+        step_ops = list(self._block.ops)
+        x_vars = [full for _, full in self._step_inputs]
+        # scan is time-major; wrap with transposes
+        from . import tensor as T
+        xs_tm = [T.transpose(x, [1, 0] + list(range(2, len(x.shape))))
+                 for x in x_vars]
+        init_vars = [init for _, init in self._mems]
+        carry_names = [pre.name for pre, _ in self._mems]
+        carry_out_names = [self._mem_updates[n].name for n in carry_names]
+        x_names = [sv.name for sv, _ in self._step_inputs]
+        y_names = [o.name for o in self._step_outputs]
+
+        lasts = [gb.create_var(shape=i.shape, dtype=str(i.dtype))
+                 for i in init_vars]
+        ys = [gb.create_var(shape=(x_vars[0].shape[1],) + tuple(o.shape),
+                            dtype=str(o.dtype)) for o in self._step_outputs]
+        gb.append_op(
+            "scan_block",
+            {"X": xs_tm, "Init": init_vars},
+            {"Last": lasts, "Ys": ys},
+            {"step_ops": step_ops, "x_step_names": x_names,
+             "carry_names": carry_names, "carry_out_names": carry_out_names,
+             "y_names": y_names})
+        outs = [T.transpose(y, [1, 0] + list(range(2, len(y.shape))))
+                for y in ys]
+        return outs[0] if len(outs) == 1 else outs
+
+
+class While:
+    """While loop (ref ``control_flow.py:504``) lowered to lax.while_loop.
+    Loop-carried vars must be listed via ``loop_vars``."""
+
+    def __init__(self, cond, loop_vars=None, name=None):
+        self.cond_var = cond
+        self.loop_vars = loop_vars or []
+        self.helper = LayerHelper("while", name=name)
+        self._guard = None
+
+    def block(self):
+        outer = self
+        prog = framework.default_main_program()
+
+        class _Guard(BlockGuard):
+            def __init__(self):
+                super().__init__(prog)
+
+            def __enter__(self):
+                outer._block = super().__enter__()
+                return outer._block
+
+            def __exit__(self, *exc):
+                r = super().__exit__(*exc)
+                if exc and exc[0] is not None:
+                    return r
+                gb = prog.global_block()
+                body_ops = list(outer._block.ops)
+                outs = [gb.create_var(shape=v.shape, dtype=str(v.dtype))
+                        for v in outer.loop_vars]
+                gb.append_op(
+                    "while_block",
+                    {"Carry": list(outer.loop_vars)},
+                    {"Out": outs},
+                    {"body_ops": body_ops,
+                     "cond_name": outer.cond_var.name})
+                for v, o in zip(outer.loop_vars, outs):
+                    # rebind names so later layers see updated values
+                    o.name = v.name
+                    gb.vars[v.name] = o
+                return r
+
+        return _Guard()
+
+
+class Switch:
+    """Piecewise-case construct (ref ``control_flow.py:1138``), commonly used
+    for LR schedules. First-match semantics: each case is guarded by
+    ``its_cond AND NOT(any prior cond)``; the default by ``NOT(any cond)``.
+    Lowered to jnp.where blending in run_op (see op_registry)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._prior_conds = []
+
+    def case(self, condition):
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _SwitchCase:
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+        self.prog = framework.default_main_program()
+
+    def __enter__(self):
+        self.block = self.prog._create_block()
+        return self.block
+
+    def __exit__(self, *a):
+        self.prog._rollback()
+        ops = list(self.block.ops)
+        gb = self.prog.global_block()
+
+        # effective condition = this cond AND NOT(prior conds); default =
+        # NOT(any prior cond). Built with ops so it traces into the jit.
+        def _not(v):
+            o = gb.create_var(shape=v.shape or (1,), dtype="bool")
+            gb.append_op("logical_not", {"X": v}, {"Out": o}, {})
+            return o
+
+        def _and(a, b):
+            o = gb.create_var(shape=a.shape or (1,), dtype="bool")
+            gb.append_op("logical_and", {"X": a, "Y": b}, {"Out": o}, {})
+            return o
+
+        eff = self.condition
+        for prior in self.switch._prior_conds:
+            np_ = _not(prior)
+            eff = np_ if eff is None else _and(eff, np_)
+        if self.condition is not None:
+            self.switch._prior_conds.append(self.condition)
+        for op in ops:
+            if eff is not None:
+                op.attrs["_switch_cond"] = eff.name
+            gb.ops.append(op)
+            self.prog._version += 1
+        return False
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """Functional conditional (modern jax-style; the reference's
+    ``ConditionalBlock`` pattern is subsumed): both branches are traced
+    symbolically and lowered to lax.cond."""
+    prog = framework.default_main_program()
+    tb = prog._create_block()
+    true_out = true_fn()
+    prog._rollback()
+    fb = prog._create_block()
+    false_out = false_fn()
+    prog._rollback()
+    gb = prog.global_block()
+    t_outs = true_out if isinstance(true_out, (list, tuple)) else [true_out]
+    f_outs = false_out if isinstance(false_out, (list, tuple)) else [false_out]
+    outs = [gb.create_var(shape=v.shape, dtype=str(v.dtype)) for v in t_outs]
+    # record branch output names so the impl can fetch them
+    gb.append_op(
+        "cond_block", {"Cond": pred}, {"Out": outs},
+        {"true_ops": list(tb.ops), "false_ops": list(fb.ops),
+         "true_out_names": [v.name for v in t_outs],
+         "false_out_names": [v.name for v in f_outs]})
+    return outs[0] if len(outs) == 1 else outs
+
+
+def array_write(x, i, array=None):
+    raise NotImplementedError(
+        "tensor_array ops land with beam-search in a later round")
+
+
+def array_read(array, i):
+    raise NotImplementedError(
+        "tensor_array ops land with beam-search in a later round")
