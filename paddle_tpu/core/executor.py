@@ -165,9 +165,14 @@ class Executor:
                 var = program.global_block().var(name)
             feed_arrays[name] = _as_array(value, var)
 
-        # seed rng on first use
+        # seed rng on first use; random_seed=0 means nondeterministic
+        # (reference Program.random_seed semantics)
         if RNG_KEY not in scope:
-            seed = program.random_seed or 0
+            if program.random_seed:
+                seed = program.random_seed
+            else:
+                import secrets
+                seed = secrets.randbits(31)
             scope.set(RNG_KEY, jax.random.PRNGKey(seed))
 
         persist_names = sorted({v.name for v in program.list_vars()

@@ -94,7 +94,8 @@ def bcast_y(x, y, axis):
     axis=-1 means align trailing dims (numpy broadcasting)."""
     if axis is None:
         axis = -1
-    if x.ndim == y.ndim or y.ndim == 0:
+    if y.ndim >= x.ndim or y.ndim == 0:
+        # equal-rank or y-broader: plain numpy broadcasting applies
         return y
     if axis == -1:
         axis = x.ndim - y.ndim

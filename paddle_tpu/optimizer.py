@@ -114,6 +114,7 @@ class Optimizer:
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
+        self._final_params_grads = params_grads
         return self._create_optimization_pass(params_grads)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
@@ -121,7 +122,9 @@ class Optimizer:
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
         optimize_ops = self.apply_gradients(params_grads)
-        return optimize_ops, params_grads
+        # return the post-clip/regularization pairs (what the update ops
+        # actually consume) — more useful than the raw backward outputs
+        return optimize_ops, self._final_params_grads
 
 
 class SGD(Optimizer):

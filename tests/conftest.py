@@ -1,0 +1,48 @@
+"""Test config: force an 8-device virtual CPU mesh (SURVEY.md §4 TPU test
+plan — multi-device tests run on host-local virtual devices, the analog of
+the reference's multi-GPU CI boxes)."""
+
+import os
+
+# The image pre-sets JAX_PLATFORMS=axon,cpu (real TPU via tunnel) — tests
+# must force CPU: override the env BEFORE jax initializes AND via config
+# (the axon plugin wins otherwise and float32 matmuls run in bf16 on the
+# TPU, breaking numeric gradient checks).
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_programs():
+    """Give every test fresh default programs + scope + name generator
+    (tests build graphs into module-level singletons)."""
+    import paddle_tpu as fluid
+    from paddle_tpu.core import framework, unique_name
+    from paddle_tpu.core import executor as executor_mod
+
+    prev_main = framework.switch_main_program(framework.Program())
+    prev_startup = framework.switch_startup_program(framework.Program())
+    old_gen = unique_name.switch()
+    scope = executor_mod.Scope()
+    executor_mod._scope_stack.append(scope)
+    yield
+    executor_mod._scope_stack.pop()
+    unique_name.switch(old_gen)
+    framework.switch_main_program(prev_main)
+    framework.switch_startup_program(prev_startup)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(1234)

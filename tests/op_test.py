@@ -1,0 +1,102 @@
+"""OpTest harness — the analog of the reference's
+``tests/unittests/op_test.py:133`` (single-op programs checked against
+numpy references; numeric gradient checking via central differences
+``op_test.py:44``)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.core.framework import default_main_program
+
+
+def build_single_op_program(op_type, inputs, attrs=None, out_slots=("Out",),
+                            out_shapes=None, out_dtypes=None, lod=None):
+    """Create data vars for ``inputs`` (dict name->np array), append one op,
+    return (feed_dict, {slot: out_var})."""
+    gb = default_main_program().global_block()
+    in_vars = {}
+    feed = {}
+    for slot, arrs in inputs.items():
+        if isinstance(arrs, list):
+            vs = []
+            for i, (name, a) in enumerate(arrs):
+                v = gb.create_var(name=name, shape=a.shape, dtype=str(a.dtype),
+                                  is_data=True)
+                feed[name] = a
+                vs.append(v)
+            in_vars[slot] = vs
+        else:
+            name = "in_%s" % slot.lower()
+            v = gb.create_var(name=name, shape=arrs.shape,
+                              dtype=str(arrs.dtype), is_data=True)
+            feed[name] = arrs
+            in_vars[slot] = v
+    outs = {}
+    for i, slot in enumerate(out_slots):
+        shape = out_shapes[i] if out_shapes else None
+        dtype = out_dtypes[i] if out_dtypes else "float32"
+        outs[slot] = gb.create_var(name="out_%s" % slot.lower(), shape=shape,
+                                   dtype=dtype)
+    gb.append_op(op_type, in_vars, outs, attrs or {})
+    return feed, outs
+
+
+def check_output(op_type, inputs, expected, attrs=None, atol=1e-5,
+                 rtol=1e-5):
+    """Run a single-op program (isolated per call); compare each expected
+    slot against numpy."""
+    out_slots = tuple(expected.keys())
+    out_dtypes = [str(np.asarray(e).dtype) for e in expected.values()]
+    prog = fluid.Program()
+    startup = fluid.Program()
+    with fluid.program_guard(prog, startup):
+        feed, outs = build_single_op_program(op_type, inputs, attrs,
+                                             out_slots,
+                                             out_dtypes=out_dtypes)
+        exe = fluid.Executor()
+        results = exe.run(prog, feed=feed,
+                          fetch_list=[outs[s] for s in out_slots])
+    for slot, got in zip(out_slots, results):
+        want = np.asarray(expected[slot])
+        np.testing.assert_allclose(
+            got.astype(np.float64) if got.dtype.kind == "f" else got,
+            want.astype(np.float64) if want.dtype.kind == "f" else want,
+            atol=atol, rtol=rtol,
+            err_msg="op %s slot %s mismatch" % (op_type, slot))
+
+
+def check_grad(build_fn, feed, wrt_names, atol=5e-3, rtol=5e-3, delta=1e-3):
+    """Numeric-vs-autodiff gradient check, ref ``get_numeric_gradient``.
+
+    build_fn() -> scalar loss Variable (builds in the default program).
+    feed: dict name->np.float32 arrays; wrt_names ⊆ feed keys.
+    """
+    loss = build_fn()
+    grads = fluid.calc_gradient(
+        loss, [default_main_program().global_block().var(n)
+               for n in wrt_names])
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    analytic = exe.run(feed=feed, fetch_list=grads)
+
+    def eval_loss(f):
+        return float(exe.run(feed=f, fetch_list=[loss])[0])
+
+    for name, a_grad in zip(wrt_names, analytic):
+        base = feed[name].astype(np.float64)
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = num.reshape(-1)
+        for i in range(flat.size):
+            fplus = dict(feed)
+            v = flat.copy()
+            v[i] += delta
+            fplus[name] = v.reshape(base.shape).astype(feed[name].dtype)
+            fminus = dict(feed)
+            v2 = flat.copy()
+            v2[i] -= delta
+            fminus[name] = v2.reshape(base.shape).astype(feed[name].dtype)
+            num_flat[i] = (eval_loss(fplus) - eval_loss(fminus)) / (2 * delta)
+        np.testing.assert_allclose(
+            np.asarray(a_grad), num, atol=atol, rtol=rtol,
+            err_msg="gradient mismatch for %s" % name)

@@ -1,0 +1,77 @@
+"""Gradient checks: analytic (autodiff op) vs numeric central differences —
+the reference's ``check_grad`` methodology (``op_test.py:433``)."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from op_test import check_grad
+
+
+def test_fc_grad(rng):
+    x0 = rng.randn(3, 4).astype("float32")
+
+    def build():
+        x = fluid.layers.data("x", shape=[4], append_batch_size=False)
+        x.shape = (3, 4)
+        y = fluid.layers.fc(x, size=2,
+                            param_attr=fluid.ParamAttr(name="w"),
+                            bias_attr=fluid.ParamAttr(name="b"))
+        return fluid.layers.mean(fluid.layers.square(y))
+
+    check_grad(build, {"x": x0}, ["x"])
+
+
+def test_softmax_ce_grad(rng):
+    x0 = rng.randn(4, 5).astype("float32")
+    labels = rng.randint(0, 5, (4, 1)).astype("int64")
+
+    def build():
+        x = fluid.layers.data("x", shape=[5])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        return fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(x, y))
+
+    check_grad(build, {"x": x0, "y": labels}, ["x"])
+
+
+def test_tanh_chain_grad(rng):
+    x0 = rng.randn(2, 3).astype("float32")
+
+    def build():
+        x = fluid.layers.data("x", shape=[3])
+        h = fluid.layers.tanh(x)
+        h = fluid.layers.sigmoid(h)
+        return fluid.layers.reduce_sum(h)
+
+    check_grad(build, {"x": x0}, ["x"])
+
+
+def test_append_backward_param_grads(rng):
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(x, size=3, param_attr=fluid.ParamAttr(name="w2"),
+                        bias_attr=False)
+    loss = fluid.layers.mean(y)
+    p_g = fluid.append_backward(loss)
+    assert len(p_g) == 1
+    param, grad = p_g[0]
+    assert param.name == "w2"
+    assert grad.name == "w2@GRAD"
+
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = rng.randn(6, 4).astype("float32")
+    g, = exe.run(feed={"x": xs}, fetch_list=[grad])
+    # d(mean(xW))/dW = mean over batch of x / out_dim
+    want = np.repeat(xs.mean(0, keepdims=True).T, 3, axis=1) / (6 * 3) * 6
+    np.testing.assert_allclose(g, np.tile(xs.mean(0)[:, None], (1, 3)) / 3,
+                               atol=1e-5)
+
+
+def test_stop_gradient_data(rng):
+    """Data vars are stop_gradient; only trainable params get grads."""
+    x = fluid.layers.data("x", shape=[4])
+    h = fluid.layers.fc(x, size=4, bias_attr=False)
+    loss = fluid.layers.mean(h)
+    p_g = fluid.append_backward(loss)
+    names = [p.name for p, _ in p_g]
+    assert all("w" in n or "fc" in n for n in names)

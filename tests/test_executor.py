@@ -1,0 +1,116 @@
+"""Executor behaviors: program cache, scopes, clone(for_test), state
+updates, rng reproducibility."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+
+
+def _build_classifier(hidden=16, classes=3, dim=8, dropout=0.0):
+    x = fluid.layers.data("x", shape=[dim])
+    y = fluid.layers.data("y", shape=[1], dtype="int64")
+    h = fluid.layers.fc(x, size=hidden, act="relu")
+    if dropout:
+        h = fluid.layers.dropout(h, dropout_prob=dropout)
+    logits = fluid.layers.fc(h, size=classes)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, y))
+    return loss
+
+
+def test_training_decreases_loss(rng):
+    loss = _build_classifier()
+    fluid.optimizer.SGD(0.5).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    protos = rng.normal(size=(3, 8)).astype("float32")
+    ys = rng.randint(0, 3, (32, 1)).astype("int64")
+    xs = (protos[ys[:, 0]] + 0.2 * rng.normal(size=(32, 8))).astype("float32")
+    ls = [float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+          for _ in range(25)]
+    assert ls[-1] < 0.5 * ls[0]
+
+
+def test_momentum_and_weight_decay(rng):
+    loss = _build_classifier()
+    opt = fluid.optimizer.Momentum(
+        0.1, momentum=0.9,
+        regularization=fluid.regularizer.L2Decay(1e-4))
+    opt.minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    ys = rng.randint(0, 3, (16, 1)).astype("int64")
+    xs = rng.normal(size=(16, 8)).astype("float32")
+    l0 = float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+    for _ in range(10):
+        lv = float(exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0])
+    assert lv < l0
+
+
+def test_clone_for_test_disables_dropout(rng):
+    loss = _build_classifier(dropout=0.9)
+    test_prog = fluid.default_main_program().clone(for_test=True)
+    fluid.optimizer.SGD(0.0).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = rng.normal(size=(8, 8)).astype("float32")
+    ys = np.zeros((8, 1), "int64")
+    test_loss = test_prog.global_block().var(loss.name)
+    a = exe.run(test_prog, feed={"x": xs, "y": ys}, fetch_list=[test_loss])[0]
+    b = exe.run(test_prog, feed={"x": xs, "y": ys}, fetch_list=[test_loss])[0]
+    # deterministic in test mode (dropout off)
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_scope_isolation(rng):
+    loss = _build_classifier()
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = rng.normal(size=(4, 8)).astype("float32")
+    ys = np.zeros((4, 1), "int64")
+    l1 = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0]
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(fluid.default_startup_program())
+        l2 = exe.run(feed={"x": xs, "y": ys}, fetch_list=[loss])[0]
+    # different init draws in different scopes -> different losses
+    assert not np.allclose(l1, l2)
+
+
+def test_lr_scheduler_decays(rng):
+    x = fluid.layers.data("x", shape=[4])
+    loss = fluid.layers.mean(fluid.layers.fc(x, size=2))
+    lr = fluid.layers.exponential_decay(0.1, decay_steps=1, decay_rate=0.5)
+    fluid.optimizer.SGD(lr).minimize(loss)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = rng.normal(size=(2, 4)).astype("float32")
+    lrs = [float(exe.run(feed={"x": xs}, fetch_list=[lr])[0])
+           for _ in range(3)]
+    np.testing.assert_allclose(lrs, [0.1, 0.05, 0.025], rtol=1e-5)
+
+
+def test_fetch_persistable_and_feed_fetch(rng):
+    x = fluid.layers.data("x", shape=[4])
+    h = fluid.layers.fc(x, size=2, param_attr=fluid.ParamAttr(name="wfetch"),
+                        bias_attr=False)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = rng.normal(size=(2, 4)).astype("float32")
+    w, xv = exe.run(feed={"x": xs}, fetch_list=["wfetch", "x"])
+    assert w.shape == (4, 2)
+    np.testing.assert_allclose(xv, xs)
+
+
+def test_global_norm_clip(rng):
+    x = fluid.layers.data("x", shape=[4])
+    y = fluid.layers.fc(x, size=3, bias_attr=False)
+    loss = fluid.layers.mean(fluid.layers.square(y)) * 1000.0
+    fluid.set_gradient_clip(fluid.clip.GradientClipByGlobalNorm(1.0))
+    opt = fluid.optimizer.SGD(1.0)
+    _, p_g = opt.minimize(loss)
+    fluid.set_gradient_clip(None)
+    exe = fluid.Executor()
+    exe.run(fluid.default_startup_program())
+    xs = rng.normal(size=(4, 4)).astype("float32")
+    g = exe.run(feed={"x": xs}, fetch_list=[p_g[0][1]])[0]
+    assert np.sqrt((g ** 2).sum()) <= 1.0 + 1e-4

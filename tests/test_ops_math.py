@@ -1,0 +1,101 @@
+"""Per-op forward tests vs numpy references (ref test strategy §4.1)."""
+
+import numpy as np
+import pytest
+
+from op_test import check_output
+
+
+def test_elementwise_add_broadcast_axis(rng):
+    x = rng.rand(2, 3, 4).astype("float32")
+    y = rng.rand(3).astype("float32")
+    check_output("elementwise_add", {"X": x, "Y": y},
+                 {"Out": x + y.reshape(1, 3, 1)}, {"axis": 1})
+
+
+def test_elementwise_family(rng):
+    x = rng.rand(4, 5).astype("float32") + 0.5
+    y = rng.rand(4, 5).astype("float32") + 0.5
+    for op, fn in [("elementwise_add", np.add), ("elementwise_sub", np.subtract),
+                   ("elementwise_mul", np.multiply),
+                   ("elementwise_div", np.divide),
+                   ("elementwise_max", np.maximum),
+                   ("elementwise_min", np.minimum)]:
+        check_output(op, {"X": x, "Y": y}, {"Out": fn(x, y)})
+
+
+def test_activations(rng):
+    x = rng.randn(3, 7).astype("float32")
+    check_output("relu", {"X": x}, {"Out": np.maximum(x, 0)})
+    check_output("sigmoid", {"X": x}, {"Out": 1 / (1 + np.exp(-x))})
+    check_output("tanh", {"X": x}, {"Out": np.tanh(x)})
+    check_output("leaky_relu", {"X": x},
+                 {"Out": np.where(x > 0, x, 0.1 * x)}, {"alpha": 0.1})
+    check_output("softplus", {"X": x}, {"Out": np.log1p(np.exp(x))},
+                 atol=1e-4)
+
+
+def test_matmul_transpose(rng):
+    x = rng.rand(3, 4).astype("float32")
+    y = rng.rand(5, 4).astype("float32")
+    check_output("matmul", {"X": x, "Y": y}, {"Out": x @ y.T},
+                 {"transpose_Y": True})
+
+
+def test_mul_flatten(rng):
+    x = rng.rand(2, 3, 4).astype("float32")
+    y = rng.rand(12, 5).astype("float32")
+    check_output("mul", {"X": x, "Y": y},
+                 {"Out": x.reshape(2, 12) @ y}, {"x_num_col_dims": 1})
+
+
+def test_reduce_ops(rng):
+    x = rng.rand(3, 4, 5).astype("float32")
+    check_output("reduce_sum", {"X": x}, {"Out": x.sum(axis=1)}, {"dim": [1]})
+    check_output("reduce_mean", {"X": x},
+                 {"Out": x.mean(axis=(0, 2))}, {"dim": [0, 2]})
+    check_output("reduce_max", {"X": x},
+                 {"Out": x.max(axis=2, keepdims=True)},
+                 {"dim": [2], "keep_dim": True})
+
+
+def test_softmax_and_losses(rng):
+    x = rng.randn(4, 6).astype("float32")
+    e = np.exp(x - x.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    check_output("softmax", {"X": x}, {"Out": sm})
+    label = rng.randint(0, 6, (4, 1)).astype("int64")
+    expected = -np.log(sm[np.arange(4), label[:, 0]])[:, None]
+    check_output("softmax_with_cross_entropy",
+                 {"Logits": x, "Label": label}, {"Loss": expected},
+                 atol=1e-4)
+
+
+def test_cumsum_modes(rng):
+    x = np.array([1.0, 2.0, 3.0], dtype="float32")
+    check_output("cumsum", {"X": x}, {"Out": np.array([1, 3, 6], "float32")},
+                 {"axis": 0})
+    check_output("cumsum", {"X": x}, {"Out": np.array([0, 1, 3], "float32")},
+                 {"axis": 0, "exclusive": True})
+    check_output("cumsum", {"X": x}, {"Out": np.array([6, 5, 3], "float32")},
+                 {"axis": 0, "reverse": True})
+    check_output("cumsum", {"X": x}, {"Out": np.array([5, 3, 0], "float32")},
+                 {"axis": 0, "reverse": True, "exclusive": True})
+
+
+def test_topk_argmax(rng):
+    x = rng.rand(3, 8).astype("float32")
+    idx = np.argsort(-x, axis=1)[:, :3]
+    vals = np.take_along_axis(x, idx, 1)
+    check_output("top_k", {"X": x}, {"Out": vals, "Indices": idx.astype("int64")},
+                 {"k": 3})
+    check_output("argmax", {"X": x},
+                 {"Out": x.argmax(1).astype("int64")}, {"axis": 1})
+
+
+def test_clip_scale(rng):
+    x = rng.randn(4, 4).astype("float32")
+    check_output("clip", {"X": x}, {"Out": np.clip(x, -0.5, 0.5)},
+                 {"min": -0.5, "max": 0.5})
+    check_output("scale", {"X": x}, {"Out": 2.0 * x + 1.0},
+                 {"scale": 2.0, "bias": 1.0})

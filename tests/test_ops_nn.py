@@ -1,0 +1,108 @@
+"""NN op forward tests vs numpy references."""
+
+import numpy as np
+import pytest
+
+from op_test import check_output
+
+
+def _conv2d_np(x, w, stride, pad):
+    n, c, h, wd = x.shape
+    oc, ic, kh, kw = w.shape
+    xp = np.pad(x, [(0, 0), (0, 0), (pad, pad), (pad, pad)])
+    oh = (h + 2 * pad - kh) // stride + 1
+    ow = (wd + 2 * pad - kw) // stride + 1
+    out = np.zeros((n, oc, oh, ow), dtype=x.dtype)
+    for i in range(oh):
+        for j in range(ow):
+            patch = xp[:, :, i * stride:i * stride + kh,
+                       j * stride:j * stride + kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+def test_conv2d(rng):
+    x = rng.randn(2, 3, 8, 8).astype("float32")
+    w = rng.randn(4, 3, 3, 3).astype("float32")
+    check_output("conv2d", {"Input": x, "Filter": w},
+                 {"Output": _conv2d_np(x, w, 1, 1)},
+                 {"strides": [1, 1], "paddings": [1, 1]}, atol=1e-4)
+
+
+def test_pool2d_max_avg(rng):
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    mx = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+    av = x.reshape(2, 3, 2, 2, 2, 2).mean(axis=(3, 5))
+    check_output("pool2d", {"X": x}, {"Out": mx},
+                 {"pooling_type": "max", "ksize": [2, 2], "strides": [2, 2]})
+    check_output("pool2d", {"X": x}, {"Out": av},
+                 {"pooling_type": "avg", "ksize": [2, 2], "strides": [2, 2]})
+
+
+def test_batch_norm_infer(rng):
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    scale = rng.rand(3).astype("float32")
+    bias = rng.rand(3).astype("float32")
+    mean = rng.rand(3).astype("float32")
+    var = (rng.rand(3) + 0.5).astype("float32")
+    eps = 1e-5
+    want = (x - mean.reshape(1, 3, 1, 1)) / np.sqrt(
+        var.reshape(1, 3, 1, 1) + eps) * scale.reshape(1, 3, 1, 1) \
+        + bias.reshape(1, 3, 1, 1)
+    check_output("batch_norm",
+                 {"X": x, "Scale": scale, "Bias": bias, "Mean": mean,
+                  "Variance": var},
+                 {"Y": want}, {"is_test": True, "epsilon": eps}, atol=1e-4)
+
+
+def test_layer_norm(rng):
+    x = rng.randn(4, 10).astype("float32")
+    scale = rng.rand(10).astype("float32")
+    bias = rng.rand(10).astype("float32")
+    mu = x.mean(1, keepdims=True)
+    sd = x.std(1, keepdims=True)
+    want = (x - mu) / np.sqrt(sd ** 2 + 1e-5) * scale + bias
+    check_output("layer_norm", {"X": x, "Scale": scale, "Bias": bias},
+                 {"Y": want}, {"begin_norm_axis": 1}, atol=1e-4)
+
+
+def test_lookup_table_padding(rng):
+    w = rng.randn(10, 4).astype("float32")
+    ids = np.array([[1], [0], [3]], dtype="int64")
+    want = w[[1, 0, 3]]
+    want[1] = 0.0  # padding_idx=0
+    check_output("lookup_table", {"W": w, "Ids": ids}, {"Out": want},
+                 {"padding_idx": 0})
+
+
+def test_one_hot():
+    ids = np.array([[1], [3]], dtype="int64")
+    want = np.zeros((2, 4), "float32")
+    want[0, 1] = want[1, 3] = 1
+    check_output("one_hot", {"X": ids}, {"Out": want}, {"depth": 4})
+
+
+def test_dropout_is_test(rng):
+    x = rng.randn(3, 5).astype("float32")
+    check_output("dropout", {"X": x}, {"Out": x * 0.7},
+                 {"dropout_prob": 0.3, "is_test": True})
+
+
+def test_sequence_pool_masked(rng):
+    x = rng.randn(2, 4, 3).astype("float32")
+    lengths = np.array([2, 4], dtype="int64")
+    want = np.stack([x[0, :2].sum(0), x[1, :4].sum(0)])
+    check_output("sequence_pool",
+                 {"X": x, "Lengths": lengths}, {"Out": want},
+                 {"pooltype": "SUM"})
+    want_last = np.stack([x[0, 1], x[1, 3]])
+    check_output("sequence_pool",
+                 {"X": x, "Lengths": lengths}, {"Out": want_last},
+                 {"pooltype": "LAST"})
+
+
+def test_interp_nearest(rng):
+    x = rng.randn(1, 2, 2, 2).astype("float32")
+    want = x.repeat(2, axis=2).repeat(2, axis=3)
+    check_output("nearest_interp", {"X": x}, {"Out": want},
+                 {"out_h": 4, "out_w": 4, "align_corners": False})
