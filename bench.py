@@ -1,0 +1,83 @@
+"""Benchmark: Transformer-base NMT training throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+``vs_baseline`` is model FLOPs utilization (MFU) relative to the
+BASELINE.json north-star target of 45% MFU (>1.0 beats the target).
+Measurement follows the reference convention of examples/sec
+(``benchmark/fluid/fluid_benchmark.py:297``) expressed per-token.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _peak_flops(device):
+    """Peak bf16 matmul FLOPs/s for the benched chip (fallback 1e14)."""
+    kind = getattr(device, "device_kind", "").lower()
+    table = {
+        "v5e": 394e12, "v5litepod": 394e12, "v4": 275e12, "v5p": 459e12,
+        "v6e": 918e12, "v3": 123e12, "v2": 45e12,
+    }
+    for k, v in table.items():
+        if k in kind:
+            return v
+    if device.platform == "cpu":
+        return 1e11  # nominal, for smoke runs
+    return 1e14
+
+
+def main():
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    seq_len = 256
+    batch = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 4))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
+    if not on_tpu:
+        seq_len = 64
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        spec = models.transformer.transformer_base(
+            seq_len=seq_len, dropout_rate=0.1)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        opt.minimize(spec.loss)
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = spec.sample_batch(batch, np.random.RandomState(0))
+        # warmup: compile + 2 steps
+        for _ in range(2):
+            loss_val, = exe.run(main_prog, feed=feed,
+                                fetch_list=[spec.loss])
+        np.asarray(loss_val)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss_val, = exe.run(main_prog, feed=feed,
+                                fetch_list=[spec.loss])
+        np.asarray(loss_val)  # sync
+        dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * spec.tokens_per_example
+    tokens_per_sec = tokens_per_step * steps / dt
+    flops_per_step = spec.flops_per_example * batch
+    mfu = (flops_per_step * steps / dt) / _peak_flops(jax.devices()[0])
+    out = {
+        "metric": "transformer_base_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

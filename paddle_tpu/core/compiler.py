@@ -58,18 +58,21 @@ class CompiledProgram:
         self._program = program
         self._mesh = None
         self._dp_axis = None
+        self._sp_axis = None
         self._build_strategy = None
         self._exec_strategy = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
-                           places=None, mesh=None, dp_axis="dp"):
+                           places=None, mesh=None, dp_axis="dp",
+                           sp_axis=None):
         """Shard the batch over a device mesh axis (ref
         ``compiler.py:116``). ``mesh`` defaults to a 1-D mesh over all local
         devices — the analog of ParallelExecutor claiming all visible GPUs."""
         self._build_strategy = build_strategy or BuildStrategy()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._dp_axis = dp_axis
+        self._sp_axis = sp_axis
         self._mesh = mesh
         self._places = places
         return self

@@ -131,6 +131,28 @@ def _as_array(value, var=None):
     return arr
 
 
+def build_step_fn(program, fetch_names, persist_names):
+    """Trace a program's global block into one pure function
+    ``(state, feed, rng) -> (fetches, new_state, rng')`` — the unit the
+    Executor jits, ``__graft_entry__`` exposes, and bench.py times."""
+    ops = list(program.global_block().ops)
+    persist_set = set(persist_names)
+
+    def step(state, feed, rng):
+        env = {}
+        env.update(state)
+        env.update(feed)
+        env[RNG_KEY] = rng
+        env[RNG0_KEY] = rng
+        for op in ops:
+            run_op(env, op)
+        fetches = tuple(env[n] for n in fetch_names)
+        new_state = {n: env[n] for n in persist_set if n in env}
+        return fetches, new_state, env[RNG_KEY]
+
+    return step
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else XLAPlace(0)
@@ -146,9 +168,11 @@ class Executor:
             program = framework.default_main_program()
         mesh = None
         dp_axis = None
+        sp_axis = None
         if isinstance(program, CompiledProgram):
             mesh = program._resolve_mesh()
             dp_axis = program._dp_axis
+            sp_axis = program._sp_axis
             program = program._program
         if scope is None:
             scope = global_scope()
@@ -182,12 +206,12 @@ class Executor:
         feed_sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               state_in_names, id(scope), mesh is not None)
+               state_in_names, id(scope), mesh, dp_axis, sp_axis)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._compile(program, tuple(sorted(feed_arrays)),
                                   fetch_names, state_in_names, persist_names,
-                                  mesh, dp_axis)
+                                  mesh, dp_axis, sp_axis)
             if use_program_cache:
                 self._cache[key] = entry
         jfn = entry
@@ -209,22 +233,8 @@ class Executor:
 
     # -- compilation --------------------------------------------------------
     def _compile(self, program, feed_names, fetch_names, state_in_names,
-                 persist_names, mesh, dp_axis):
-        ops = list(program.global_block().ops)
-        persist_set = set(persist_names)
-
-        def step(state, feed, rng):
-            env = {}
-            env.update(state)
-            env.update(feed)
-            env[RNG_KEY] = rng
-            env[RNG0_KEY] = rng
-            for op in ops:
-                run_op(env, op)
-            fetches = tuple(env[n] for n in fetch_names)
-            new_state = {n: env[n] for n in persist_set if n in env}
-            return fetches, new_state, env[RNG_KEY]
-
+                 persist_names, mesh, dp_axis, sp_axis=None):
+        step = build_step_fn(program, fetch_names, persist_names)
         donate = (0,)
         if mesh is None:
             return jax.jit(step, donate_argnums=donate)
@@ -236,13 +246,54 @@ class Executor:
         # multi_devices_graph_pass + NCCL allreduce op handles.
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        mesh_axes = set(mesh.axis_names)
+
+        def to_spec(var):
+            spec = getattr(var, "sharding", None)
+            if spec is None:
+                return P()
+            # axes absent from this mesh degrade to replication, so an
+            # mp-annotated program runs unchanged on a dp-only mesh
+            return P(*[a if a in mesh_axes else None for a in spec])
+
         param_shardings = {}
-        for p in program.all_parameters():
-            spec = p.sharding if p.sharding is not None else (None,) * len(p.shape)
-            param_shardings[p.name] = NamedSharding(mesh, P(*spec))
+        for v in program.list_vars():
+            if v.persistable and getattr(v, "sharding", None) is not None:
+                param_shardings[v.name] = NamedSharding(mesh, to_spec(v))
         repl = NamedSharding(mesh, P())
 
         state_shard = {n: param_shardings.get(n, repl) for n in state_in_names}
-        feed_shard = {n: NamedSharding(mesh, P(dp_axis)) for n in feed_names}
+
+        sp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(sp_axis)
+
+        def feed_spec(name):
+            # batch axis -> dp; with sequence parallelism, axis 1 of [B,S,...]
+            # feeds -> sp (ring-attention-style context sharding; GSPMD
+            # all-gathers where an op needs the full sequence). Only applied
+            # where dim 1 is a static sequence length divisible by the sp
+            # axis — labels [B,1] / field ids [B,F] stay dp-only.
+            gb = program.global_block()
+            shp = gb.var(name).shape if gb.has_var(name) else None
+            if (sp_axis is not None and shp is not None and len(shp) >= 2
+                    and shp[1] > 1 and shp[1] % sp_size == 0):
+                return NamedSharding(mesh, P(dp_axis, sp_axis))
+            return NamedSharding(mesh, P(dp_axis))
+
+        feed_shard = {n: feed_spec(n) for n in feed_names}
         in_shardings = (state_shard, feed_shard, repl)
-        return jax.jit(step, donate_argnums=donate, in_shardings=in_shardings)
+
+        # pin state OUTPUT shardings to the input layout: otherwise GSPMD
+        # picks per-call layouts for un-annotated state and the next step's
+        # cached executable rejects the donated arrays
+        produced = set()
+        for o in program.global_block().ops:
+            produced.update(o.output_arg_names)
+        out_state = {n for n in persist_names
+                     if n in produced or n in state_in_names}
+        out_shardings = (
+            tuple(repl for _ in fetch_names),
+            {n: param_shardings.get(n, repl) for n in out_state},
+            repl)
+        return jax.jit(step, donate_argnums=donate,
+                       in_shardings=in_shardings,
+                       out_shardings=out_shardings)

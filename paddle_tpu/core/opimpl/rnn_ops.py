@@ -130,3 +130,29 @@ def _gru_seq(env, op):
     if is_reverse:
         hs = jnp.flip(hs, axis=0)
     put(env, op.output("Hidden"), jnp.swapaxes(hs, 0, 1))
+
+
+@register("gru_unit")
+def _gru_unit(env, op):
+    """One GRU step (ref ``operators/gru_unit_op.cc``): Input [B,3H] is the
+    pre-projected x, HiddenPrev [B,H]; same gate order as gru_seq."""
+    x = get(env, op.input("Input"))
+    h_prev = get(env, op.input("HiddenPrev"))
+    w = get(env, op.input("Weight"))
+    bias = get(env, op.input("Bias"))
+    h_sz = h_prev.shape[-1]
+    origin_mode = op.attr("origin_mode", False)
+    xg = x[:, : 2 * h_sz]
+    xc = x[:, 2 * h_sz:]
+    if bias is not None:
+        bias = bias.reshape(-1)
+        xg = xg + bias[: 2 * h_sz]
+        xc = xc + bias[2 * h_sz:]
+    g = jax.nn.sigmoid(xg + h_prev @ w[:, : 2 * h_sz])
+    u, r = jnp.split(g, 2, axis=-1)
+    c = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * h_sz:])
+    if origin_mode:
+        h = u * h_prev + (1 - u) * c
+    else:
+        h = (1 - u) * h_prev + u * c
+    put(env, op.output("Hidden"), h)

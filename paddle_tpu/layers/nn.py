@@ -34,6 +34,7 @@ __all__ = [
     "multi_head_attention", "scaled_dot_product_attention",
     "row_conv", "autoincreased_step_counter", "cos_sim",
     "split", "warpctc", "nce", "hsigmoid", "cumsum",
+    "dynamic_lstm", "dynamic_gru", "lstm", "gru_unit",
 ]
 
 
@@ -62,8 +63,12 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     helper = LayerHelper("fc", param_attr=param_attr, bias_attr=bias_attr,
                          act=act, name=name)
     inputs = input if isinstance(input, (list, tuple)) else [input]
+    import copy as _copy
     attrs = ParamAttr._to_attr(param_attr)
-    attrs = attrs if isinstance(attrs, list) else [attrs] * len(inputs)
+    if not isinstance(attrs, list):
+        # one attr per input: copies, so name generation stays unique when a
+        # multi-input fc creates several weights (ref fc w_0/w_1 suffixes)
+        attrs = [attrs] + [_copy.copy(attrs) for _ in range(len(inputs) - 1)]
     mul_results = []
     for inp, attr in zip(inputs, attrs):
         in_shape = inp.shape
@@ -960,6 +965,142 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
                      {"kernels": list(k), "strides": list(s),
                       "paddings": list(p)})
     return out
+
+
+# ---------------------------------------------------------------------------
+# recurrent layers (ref ``nn.py`` dynamic_lstm/dynamic_gru over
+# ``operators/lstm_op.cc``/``gru_op.cc``; TPU-native: lax.scan over padded
+# [B, T, *] batches + explicit lengths instead of LoD)
+# ---------------------------------------------------------------------------
+
+def dynamic_lstm(input, size, lengths=None, param_attr=None, bias_attr=None,
+                 use_peepholes=False, is_reverse=False,
+                 gate_activation="sigmoid", cell_activation="tanh",
+                 candidate_activation="tanh", dtype=None, name=None):
+    """LSTM over a pre-projected sequence (ref ``nn.py`` dynamic_lstm).
+
+    ``input`` is ``[B, T, 4H]`` — the x@W projection done by a preceding
+    ``fc`` (matching the reference contract where ``size = 4*hidden`` and the
+    input projection is the user's fc). ``lengths`` `[B]` masks padding (the
+    LoD replacement). Returns ``(hidden [B,T,H], cell [B,T,H])``.
+    ``use_peepholes`` accepted for API parity (ignored: peephole connections
+    are off the MXU critical path and rarely used)."""
+    helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden_size = size // 4
+    dtype = dtype or _dtype(input)
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[hidden_size, 4 * hidden_size],
+                                dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[4 * hidden_size],
+                                dtype=dtype, is_bias=True)
+    b_sz, t_sz = input.shape[0], input.shape[1]
+    hidden = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(b_sz, t_sz, hidden_size))
+    cell = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(b_sz, t_sz, hidden_size))
+    inputs = {"Input": input, "Weight": w, "Bias": b}
+    if lengths is not None:
+        inputs["Lengths"] = lengths
+    helper.append_op("lstm_seq", inputs, {"Hidden": hidden, "Cell": cell},
+                     {"is_reverse": is_reverse})
+    return hidden, cell
+
+
+def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
+         num_layers=1, dropout_prob=0.0, is_bidirec=False, lengths=None,
+         is_test=False, name=None, default_initializer=None, seed=-1):
+    """Multi-layer (optionally bidirectional) LSTM on ``[B, T, D]`` input
+    (ref ``nn.py`` lstm / ``cudnn_lstm_op``). The per-layer input projection
+    is an fc (MXU matmul batched over [B*T]); recurrence is lax.scan.
+    Returns ``(out [B,T,H*dirs], last_h, last_c)`` where last_* are
+    ``[B, H*dirs]`` of the final layer."""
+    from . import tensor as tensor_layers
+    from .sequence_lod import sequence_first_step, sequence_last_step
+
+    x = input
+    hidden = None
+    cell = None
+    h_r = c_r = None
+    for layer in range(num_layers):
+        lname = None if name is None else "%s_l%d" % (name, layer)
+        proj = fc(x, size=4 * hidden_size, num_flatten_dims=2,
+                  name=None if lname is None else lname + "_proj")
+        hidden, cell = dynamic_lstm(proj, 4 * hidden_size, lengths=lengths,
+                                    name=lname)
+        if is_bidirec:
+            proj_r = fc(x, size=4 * hidden_size, num_flatten_dims=2,
+                        name=None if lname is None else lname + "_proj_r")
+            h_r, c_r = dynamic_lstm(proj_r, 4 * hidden_size, lengths=lengths,
+                                    is_reverse=True, name=lname)
+            hidden = tensor_layers.concat([hidden, h_r], axis=-1)
+        if dropout_prob and layer < num_layers - 1:
+            hidden = dropout(hidden, dropout_prob, is_test=is_test)
+        x = hidden
+    # final states per direction: forward direction ends at t=len-1; the
+    # reverse scan's final state sits at original position 0
+    fwd_h = sequence_last_step(
+        hidden if not is_bidirec else
+        tensor_layers.slice(hidden, axes=[2], starts=[0],
+                            ends=[hidden_size]), lengths=lengths)
+    fwd_c = sequence_last_step(cell, lengths=lengths)
+    if is_bidirec:
+        last_h = tensor_layers.concat(
+            [fwd_h, sequence_first_step(h_r)], axis=-1)
+        last_c = tensor_layers.concat(
+            [fwd_c, sequence_first_step(c_r)], axis=-1)
+    else:
+        last_h, last_c = fwd_h, fwd_c
+    return hidden, last_h, last_c
+
+
+def dynamic_gru(input, size, lengths=None, param_attr=None, bias_attr=None,
+                is_reverse=False, gate_activation="sigmoid",
+                candidate_activation="tanh", origin_mode=False, h_0=None,
+                name=None):
+    """GRU over a pre-projected ``[B, T, 3H]`` sequence (ref ``nn.py``
+    dynamic_gru / ``gru_op.cc``); ``size`` is the hidden width H."""
+    helper = LayerHelper("dynamic_gru", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dtype = _dtype(input)
+    w = helper.create_parameter(helper.param_attr, shape=[size, 3 * size],
+                                dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[3 * size],
+                                dtype=dtype, is_bias=True)
+    b_sz, t_sz = input.shape[0], input.shape[1]
+    hidden = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(b_sz, t_sz, size))
+    inputs = {"Input": input, "Weight": w, "Bias": b}
+    if lengths is not None:
+        inputs["Lengths"] = lengths
+    helper.append_op("gru_seq", inputs, {"Hidden": hidden},
+                     {"is_reverse": is_reverse, "origin_mode": origin_mode})
+    return hidden
+
+
+def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
+             activation="tanh", gate_activation="sigmoid", origin_mode=False,
+             name=None):
+    """Single GRU step (ref ``gru_unit_op``): ``input`` [B, 3H] pre-projected,
+    ``hidden`` [B, H] previous state. Returns the new hidden [B, H] (the
+    reference also returns gates/reset_hidden_prev; composed models only use
+    the hidden)."""
+    helper = LayerHelper("gru_unit", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden_size = size // 3
+    dtype = _dtype(input)
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[hidden_size, 3 * hidden_size],
+                                dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[3 * hidden_size],
+                                dtype=dtype, is_bias=True)
+    new_hidden = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=hidden.shape)
+    helper.append_op("gru_unit",
+                     {"Input": input, "HiddenPrev": hidden, "Weight": w,
+                      "Bias": b},
+                     {"Hidden": new_hidden}, {"origin_mode": origin_mode})
+    return new_hidden
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None,

@@ -1,0 +1,65 @@
+"""Attention seq2seq NMT with GRUs (ref ``benchmark/fluid/models/
+machine_translation.py`` / ``tests/book/test_machine_translation.py`` —
+bi-GRU encoder + attention decoder).
+
+TPU-first: teacher-forced decoding runs the whole target sequence in
+parallel — decoder GRU over the target, then (single-head) attention
+between decoder states and encoder states — instead of the reference's
+per-step DynamicRNN with in-loop attention."""
+
+from .. import layers
+from .common import FeedSpec, ModelSpec
+
+__all__ = ["seq2seq_attention"]
+
+
+def seq2seq_attention(src_vocab=10000, trg_vocab=10000, seq_len=50,
+                      emb_dim=512, hid_dim=512):
+    src = layers.data("src_ids", shape=[seq_len], dtype="int64")
+    trg = layers.data("trg_ids", shape=[seq_len], dtype="int64")
+    lbl = layers.data("lbl_ids", shape=[seq_len], dtype="int64")
+    src_len = layers.data("src_len", shape=[], dtype="int64")
+    trg_len = layers.data("trg_len", shape=[], dtype="int64")
+
+    # bi-GRU encoder
+    src_emb = layers.embedding(src, size=[src_vocab, emb_dim])
+    fwd = layers.dynamic_gru(
+        layers.fc(src_emb, size=hid_dim * 3, num_flatten_dims=2),
+        size=hid_dim, lengths=src_len)
+    bwd = layers.dynamic_gru(
+        layers.fc(src_emb, size=hid_dim * 3, num_flatten_dims=2),
+        size=hid_dim, lengths=src_len, is_reverse=True)
+    enc = layers.concat([fwd, bwd], axis=-1)  # [B, S, 2H]
+
+    # teacher-forced decoder GRU
+    trg_emb = layers.embedding(trg, size=[trg_vocab, emb_dim])
+    dec = layers.dynamic_gru(
+        layers.fc(trg_emb, size=hid_dim * 3, num_flatten_dims=2),
+        size=hid_dim, lengths=trg_len)  # [B, S, H]
+
+    # attention: decoder states attend over encoder states
+    mask = layers.sequence_mask(src_len, maxlen=seq_len, dtype="float32")
+    bias = layers.reshape(
+        layers.scale(mask, scale=1e9, bias=-1e9), [-1, 1, 1, seq_len])
+    ctx = layers.multi_head_attention(dec, enc, enc, attn_bias=bias,
+                                      d_model=hid_dim, n_head=1,
+                                      name="dec_attn")
+    merged = layers.fc(layers.concat([dec, ctx], axis=-1), size=hid_dim,
+                       num_flatten_dims=2, act="tanh")
+    logits = layers.fc(merged, size=trg_vocab, num_flatten_dims=2)
+
+    ce = layers.squeeze(layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(lbl, [2])), [2])
+    trg_mask = layers.sequence_mask(trg_len, maxlen=seq_len, dtype="float32")
+    loss = layers.elementwise_div(
+        layers.reduce_sum(layers.elementwise_mul(ce, trg_mask)),
+        layers.reduce_sum(trg_mask))
+
+    return ModelSpec(
+        loss,
+        feeds={"src_ids": FeedSpec([seq_len], "int64", 0, src_vocab),
+               "trg_ids": FeedSpec([seq_len], "int64", 0, trg_vocab),
+               "lbl_ids": FeedSpec([seq_len], "int64", 0, trg_vocab),
+               "src_len": FeedSpec([], "int64", 2, seq_len + 1),
+               "trg_len": FeedSpec([], "int64", 2, seq_len + 1)},
+        tokens_per_example=seq_len)

@@ -75,6 +75,11 @@ class Optimizer:
         prog = param.block.program
         var = prog.global_block().create_var(
             name=var_name, shape=shape, dtype=dtype, persistable=True)
+        # accumulators lay out like their parameter on the mesh (the
+        # reference keeps optimizer state on the param's device/pserver
+        # shard; here: same PartitionSpec, so sharded optimizers stay local)
+        if tuple(shape) == tuple(param.shape):
+            var.sharding = getattr(param, "sharding", None)
         sb = framework.default_startup_program().global_block()
         sp = sb.create_var(name=var_name, shape=shape, dtype=dtype,
                            persistable=True)

@@ -1,0 +1,103 @@
+"""Model-zoo tests — the analog of the reference's book tests
+(``tests/book/``: build model, train a few steps, assert loss decreases)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+def _train(spec, batch_size=8, steps=6, lr=0.01, opt=None):
+    fluid.default_main_program().random_seed = 90125  # deterministic dropout
+    opt = opt or fluid.optimizer.SGD(learning_rate=lr)
+    opt.minimize(spec.loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    rng = np.random.RandomState(7)
+    batch = spec.sample_batch(batch_size, rng)  # fixed batch: overfit check
+    losses = []
+    for _ in range(steps):
+        loss_val, = exe.run(feed=batch, fetch_list=[spec.loss])
+        losses.append(float(loss_val))
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
+    return losses
+
+
+def test_mnist_mlp_trains():
+    spec = models.mnist.mlp(hidden_sizes=(32,))
+    losses = _train(spec, lr=0.1)
+    assert losses[-1] < losses[0] * 0.95
+
+
+def test_mnist_cnn_trains():
+    spec = models.mnist.cnn()
+    _train(spec, batch_size=4, lr=0.05)
+
+
+def test_resnet_cifar_trains():
+    spec = models.resnet.resnet_cifar10(depth=8)
+    _train(spec, batch_size=4, steps=4, lr=0.05)
+
+
+def test_resnet50_builds():
+    spec = models.resnet.resnet_imagenet(depth=50, class_num=100,
+                                         image_shape=(3, 64, 64))
+    assert spec.flops_per_example and spec.flops_per_example > 0
+    n_ops = len(fluid.default_main_program().global_block().ops)
+    assert n_ops > 100
+
+
+def test_vgg_trains():
+    spec = models.vgg.vgg16(image_shape=(3, 32, 32))
+    _train(spec, batch_size=4, steps=4, lr=0.01)
+
+
+def test_se_resnext_builds_and_steps():
+    spec = models.se_resnext.se_resnext50(image_shape=(3, 64, 64),
+                                          class_num=10)
+    _train(spec, batch_size=2, steps=3, lr=0.01)
+
+
+def test_stacked_lstm_trains():
+    spec = models.stacked_lstm.stacked_lstm_net(
+        dict_size=100, emb_dim=16, hid_dim=16, stacked_num=2, seq_len=12)
+    _train(spec, batch_size=4, steps=5, lr=0.05)
+
+
+def test_transformer_trains():
+    spec = models.transformer.transformer_base(
+        src_vocab=64, trg_vocab=64, seq_len=16, d_model=32, d_ff=64,
+        n_head=2, n_layer=2, dropout_rate=0.0)
+    losses = _train(spec, batch_size=4, steps=6,
+                    opt=fluid.optimizer.Adam(learning_rate=3e-3))
+    assert losses[-1] < losses[0]
+
+
+def test_bert_trains():
+    spec = models.bert.bert_base(vocab_size=64, seq_len=16, d_model=32,
+                                 d_ff=64, n_head=2, n_layer=2,
+                                 dropout_rate=0.0)
+    _train(spec, batch_size=4, steps=5,
+           opt=fluid.optimizer.Adam(learning_rate=3e-3))
+
+
+def test_deepfm_trains():
+    spec = models.deepfm.deepfm(sparse_feature_dim=1000, num_fields=6,
+                                embedding_size=4, dense_dim=3,
+                                hidden_sizes=(16, 16))
+    _train(spec, batch_size=8, steps=5,
+           opt=fluid.optimizer.Adam(learning_rate=1e-2))
+
+
+def test_word2vec_trains():
+    spec = models.word2vec.ngram_lm(dict_size=50, emb_dim=8, hidden_size=16)
+    _train(spec, batch_size=8, steps=5, lr=0.1)
+
+
+def test_machine_translation_trains():
+    spec = models.machine_translation.seq2seq_attention(
+        src_vocab=40, trg_vocab=40, seq_len=10, emb_dim=16, hid_dim=16)
+    _train(spec, batch_size=4, steps=5,
+           opt=fluid.optimizer.Adam(learning_rate=3e-3))
