@@ -37,7 +37,7 @@ def main():
 
     on_tpu = jax.devices()[0].platform == "tpu"
     seq_len = 256
-    batch = int(os.environ.get("BENCH_BATCH", 64 if on_tpu else 4))
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
     steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
     if not on_tpu:
         seq_len = 64
@@ -47,6 +47,8 @@ def main():
         spec = models.transformer.transformer_base(
             seq_len=seq_len, dropout_rate=0.1)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        if os.environ.get("BENCH_AMP", "1") == "1":
+            opt = fluid.amp.decorate(opt)  # bf16 MXU compute
         opt.minimize(spec.loss)
 
     exe = fluid.Executor(fluid.XLAPlace(0))

@@ -38,6 +38,7 @@ from .backward import append_backward, calc_gradient, gradients  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import clip  # noqa: F401
 from . import metrics  # noqa: F401
+from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import data  # noqa: F401

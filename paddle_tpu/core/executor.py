@@ -137,15 +137,23 @@ def build_step_fn(program, fetch_names, persist_names):
     Executor jits, ``__graft_entry__`` exposes, and bench.py times."""
     ops = list(program.global_block().ops)
     persist_set = set(persist_names)
+    amp = bool(getattr(program, "_amp_bf16", False))
 
     def step(state, feed, rng):
+        from .op_registry import AMP
+
         env = {}
         env.update(state)
         env.update(feed)
         env[RNG_KEY] = rng
         env[RNG0_KEY] = rng
-        for op in ops:
-            run_op(env, op)
+        prev_amp = AMP["enabled"]
+        AMP["enabled"] = amp  # trace-time flag: fwd + autodiff replay
+        try:
+            for op in ops:
+                run_op(env, op)
+        finally:
+            AMP["enabled"] = prev_amp
         fetches = tuple(env[n] for n in fetch_names)
         new_state = {n: env[n] for n in persist_set if n in env}
         return fetches, new_state, env[RNG_KEY]
@@ -266,16 +274,26 @@ class Executor:
 
         sp_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(sp_axis)
 
+        # sequence-parallel feeds: axis 1 of [B,S,...] sequence feeds -> sp
+        # (ring-attention-style context sharding; GSPMD all-gathers where an
+        # op needs the full sequence). The sequence feeds are those whose
+        # dim 1 equals the longest candidate dim (the model's seq length) —
+        # labels [B,1] / field-id feeds stay dp-only.
+        gb = program.global_block()
+        seq_dim = None
+        if sp_size is not None:
+            dims = [gb.var(n).shape[1] for n in feed_names
+                    if gb.has_var(n) and gb.var(n).shape is not None
+                    and len(gb.var(n).shape) >= 2 and gb.var(n).shape[1] > 1]
+            if dims:
+                seq_dim = max(dims)
+                if seq_dim % sp_size != 0:
+                    seq_dim = None
+
         def feed_spec(name):
-            # batch axis -> dp; with sequence parallelism, axis 1 of [B,S,...]
-            # feeds -> sp (ring-attention-style context sharding; GSPMD
-            # all-gathers where an op needs the full sequence). Only applied
-            # where dim 1 is a static sequence length divisible by the sp
-            # axis — labels [B,1] / field ids [B,F] stay dp-only.
-            gb = program.global_block()
             shp = gb.var(name).shape if gb.has_var(name) else None
-            if (sp_axis is not None and shp is not None and len(shp) >= 2
-                    and shp[1] > 1 and shp[1] % sp_size == 0):
+            if (seq_dim is not None and shp is not None and len(shp) >= 2
+                    and shp[1] == seq_dim):
                 return NamedSharding(mesh, P(dp_axis, sp_axis))
             return NamedSharding(mesh, P(dp_axis))
 

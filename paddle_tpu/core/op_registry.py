@@ -103,3 +103,39 @@ def bcast_y(x, y, axis):
     for i, s in enumerate(y.shape):
         new_shape[axis + i] = s
     return jnp.reshape(y, new_shape)
+
+
+# ---------------------------------------------------------------------------
+# Mixed precision (trace-time flag). The reference's capability is the
+# float16_transpiler (``paddle/contrib/float16/float16_transpiler.py``) which
+# rewrites the program to fp16 kernels; the TPU-native design keeps fp32
+# master params/activations and feeds the MXU bf16 operands with fp32
+# accumulation — no loss scaling needed (bf16 keeps fp32's exponent range).
+# The flag is set while an AMP-enabled program is being traced
+# (``executor.build_step_fn``), so forward AND the autodiff replay see it.
+# ---------------------------------------------------------------------------
+
+AMP = {"enabled": False}
+
+
+def amp_enabled():
+    return AMP["enabled"]
+
+
+def mxu_cast(*xs):
+    """Cast float32 matmul/conv operands to bf16 when AMP is on."""
+    if not AMP["enabled"]:
+        return xs if len(xs) > 1 else xs[0]
+    out = tuple(
+        x.astype(jnp.bfloat16)
+        if (x is not None and hasattr(x, "dtype") and x.dtype == jnp.float32)
+        else x
+        for x in xs)
+    return out if len(out) > 1 else out[0]
+
+
+def mxu_acc_dtype(x):
+    """Accumulation dtype for MXU ops: fp32 outputs even for bf16 inputs."""
+    if AMP["enabled"]:
+        return jnp.float32
+    return None

@@ -10,16 +10,20 @@ from ..op_registry import register, get, put, next_rng
 def _flash_attention_op(env, op):
     from ...ops.flash_attention import flash_attention
 
+    from ..op_registry import mxu_cast
+
     q = get(env, op.input("Q"))
     k = get(env, op.input("K"))
     v = get(env, op.input("V"))
     bias = get(env, op.input("Bias"))
+    out_dtype = q.dtype
+    q, k, v = mxu_cast(q, k, v)
     dropout = op.attr("dropout_rate", 0.0)
     rng = next_rng(env) if dropout > 0.0 else None
     out = flash_attention(q, k, v, op.attr("num_heads", 1), bias=bias,
                           causal=op.attr("causal", False),
                           dropout_rate=dropout, rng=rng)
-    put(env, op.output("Out"), out)
+    put(env, op.output("Out"), out.astype(out_dtype))
 
 
 @register("sampling_id")

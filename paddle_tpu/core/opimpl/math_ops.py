@@ -272,7 +272,9 @@ def _mul(env, op):
     xs, ys = x.shape, y.shape
     x2 = x.reshape((int(_np.prod(xs[:xnc])), int(_np.prod(xs[xnc:]))))
     y2 = y.reshape((int(_np.prod(ys[:ync])), int(_np.prod(ys[ync:]))))
-    out = x2 @ y2
+    from ..op_registry import mxu_cast, mxu_acc_dtype
+    x2, y2 = mxu_cast(x2, y2)
+    out = jnp.matmul(x2, y2, preferred_element_type=mxu_acc_dtype(x2))
     out_shape = xs[:xnc] + ys[ync:]
     put(env, op.output("Out"), out.reshape(out_shape))
 
@@ -285,7 +287,9 @@ def _matmul(env, op):
         x = jnp.swapaxes(x, -1, -2)
     if op.attr("transpose_Y", False):
         y = jnp.swapaxes(y, -1, -2)
-    out = jnp.matmul(x, y)
+    from ..op_registry import mxu_cast, mxu_acc_dtype
+    x, y = mxu_cast(x, y)
+    out = jnp.matmul(x, y, preferred_element_type=mxu_acc_dtype(x))
     alpha = op.attr("alpha", 1.0)
     if alpha != 1.0:
         out = out * alpha

@@ -33,6 +33,8 @@ def _conv2d(env, op):
     groups = op.attr("groups", 1)
     if op.type == "depthwise_conv2d":
         groups = x.shape[1]
+    from ..op_registry import mxu_cast, mxu_acc_dtype
+    x, w = mxu_cast(x, w)
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -40,6 +42,7 @@ def _conv2d(env, op):
         rhs_dilation=dil,
         feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        preferred_element_type=mxu_acc_dtype(x),
     )
     put(env, op.output("Output"), out)
 

@@ -47,8 +47,12 @@ def _lstm_seq(env, op):
         if is_reverse:
             mask = jnp.flip(mask, axis=0)
 
-    h0 = jnp.zeros((b_sz, h_sz), xproj.dtype)
-    c0 = jnp.zeros((b_sz, h_sz), xproj.dtype)
+    h0 = get(env, op.input("H0"))
+    c0 = get(env, op.input("C0"))
+    h0 = jnp.zeros((b_sz, h_sz), xproj.dtype) if h0 is None \
+        else h0.astype(xproj.dtype)
+    c0 = jnp.zeros((b_sz, h_sz), xproj.dtype) if c0 is None \
+        else c0.astype(xproj.dtype)
 
     def step(carry, inp):
         h_prev, c_prev = carry

@@ -66,9 +66,16 @@ def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
     import copy as _copy
     attrs = ParamAttr._to_attr(param_attr)
     if not isinstance(attrs, list):
-        # one attr per input: copies, so name generation stays unique when a
-        # multi-input fc creates several weights (ref fc w_0/w_1 suffixes)
-        attrs = [attrs] + [_copy.copy(attrs) for _ in range(len(inputs) - 1)]
+        # one attr per input (ref fc w_0/w_1 suffixes): copies so unnamed
+        # attrs each generate a fresh name; explicitly named attrs get a
+        # _<i> suffix so the weights don't collide
+        copies = [attrs]
+        for i in range(1, len(inputs)):
+            c = _copy.copy(attrs)
+            if c.name is not None:
+                c.name = "%s_%d" % (c.name, i)
+            copies.append(c)
+        attrs = copies
     mul_results = []
     for inp, attr in zip(inputs, attrs):
         in_shape = inp.shape
@@ -973,7 +980,8 @@ def im2sequence(input, filter_size=1, stride=1, padding=0, input_image_size=None
 # [B, T, *] batches + explicit lengths instead of LoD)
 # ---------------------------------------------------------------------------
 
-def dynamic_lstm(input, size, lengths=None, param_attr=None, bias_attr=None,
+def dynamic_lstm(input, size, lengths=None, h_0=None, c_0=None,
+                 param_attr=None, bias_attr=None,
                  use_peepholes=False, is_reverse=False,
                  gate_activation="sigmoid", cell_activation="tanh",
                  candidate_activation="tanh", dtype=None, name=None):
@@ -982,7 +990,8 @@ def dynamic_lstm(input, size, lengths=None, param_attr=None, bias_attr=None,
     ``input`` is ``[B, T, 4H]`` — the x@W projection done by a preceding
     ``fc`` (matching the reference contract where ``size = 4*hidden`` and the
     input projection is the user's fc). ``lengths`` `[B]` masks padding (the
-    LoD replacement). Returns ``(hidden [B,T,H], cell [B,T,H])``.
+    LoD replacement); ``h_0``/``c_0`` `[B, H]` seed the recurrent state
+    (zeros when omitted). Returns ``(hidden [B,T,H], cell [B,T,H])``.
     ``use_peepholes`` accepted for API parity (ignored: peephole connections
     are off the MXU critical path and rarely used)."""
     helper = LayerHelper("dynamic_lstm", param_attr=param_attr,
@@ -1002,6 +1011,10 @@ def dynamic_lstm(input, size, lengths=None, param_attr=None, bias_attr=None,
     inputs = {"Input": input, "Weight": w, "Bias": b}
     if lengths is not None:
         inputs["Lengths"] = lengths
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
     helper.append_op("lstm_seq", inputs, {"Hidden": hidden, "Cell": cell},
                      {"is_reverse": is_reverse})
     return hidden, cell
@@ -1013,6 +1026,9 @@ def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
     """Multi-layer (optionally bidirectional) LSTM on ``[B, T, D]`` input
     (ref ``nn.py`` lstm / ``cudnn_lstm_op``). The per-layer input projection
     is an fc (MXU matmul batched over [B*T]); recurrence is lax.scan.
+    ``init_h``/``init_c`` `[B, H]` seed layer 0's forward direction (zeros
+    when omitted; deeper layers / the reverse direction always start at
+    zero). ``max_len`` is unused (static shapes carry the length).
     Returns ``(out [B,T,H*dirs], last_h, last_c)`` where last_* are
     ``[B, H*dirs]`` of the final layer."""
     from . import tensor as tensor_layers
@@ -1027,6 +1043,8 @@ def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
         proj = fc(x, size=4 * hidden_size, num_flatten_dims=2,
                   name=None if lname is None else lname + "_proj")
         hidden, cell = dynamic_lstm(proj, 4 * hidden_size, lengths=lengths,
+                                    h_0=init_h if layer == 0 else None,
+                                    c_0=init_c if layer == 0 else None,
                                     name=lname)
         if is_bidirec:
             proj_r = fc(x, size=4 * hidden_size, num_flatten_dims=2,

@@ -48,7 +48,6 @@ def deepfm(sparse_feature_dim=100000, num_fields=26, embedding_size=16,
     label_f = layers.cast(label, "float32")
     loss = layers.mean(
         layers.sigmoid_cross_entropy_with_logits(logits, label_f))
-    from ..layers import metric_op
     prob = layers.ops.sigmoid(logits)
     return ModelSpec(
         loss,

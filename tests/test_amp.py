@@ -1,0 +1,50 @@
+"""bf16 mixed-precision: numerics stay sane, params stay fp32."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+
+def _losses(amp, steps=8):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        spec = models.mnist.mlp(hidden_sizes=(32,))
+        opt = fluid.optimizer.SGD(learning_rate=0.1)
+        if amp:
+            opt = fluid.amp.decorate(opt)
+        opt.minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        batch = spec.sample_batch(16, np.random.RandomState(3))
+        out = []
+        for _ in range(steps):
+            lv, = exe.run(main, feed=batch, fetch_list=[spec.loss])
+            out.append(float(lv))
+        params = [n for n in scope.var_names()
+                  if n.startswith("mlp_") and ".w" in n]
+        assert params
+        for n in params:
+            assert str(scope.get(n).dtype) == "float32", (
+                n, scope.get(n).dtype)
+    return out
+
+
+def test_bf16_training_converges_close_to_fp32():
+    ref = _losses(amp=False)
+    amp = _losses(amp=True)
+    assert amp[-1] < amp[0]
+    # same trajectory within bf16 tolerance
+    assert abs(amp[0] - ref[0]) / ref[0] < 0.05
+    assert abs(amp[-1] - ref[-1]) / max(ref[-1], 1e-3) < 0.25
+
+
+def test_enable_disable_program_flag():
+    prog = fluid.default_main_program()
+    fluid.amp.enable_bf16(prog)
+    assert prog._amp_bf16
+    fluid.amp.disable_bf16(prog)
+    assert not prog._amp_bf16

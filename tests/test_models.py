@@ -9,7 +9,10 @@ from paddle_tpu import models
 
 
 def _train(spec, batch_size=8, steps=6, lr=0.01, opt=None):
-    fluid.default_main_program().random_seed = 90125  # deterministic dropout
+    # deterministic init + dropout: the executor seeds the scope RNG from
+    # the FIRST program it runs (the startup program), so seed both
+    fluid.default_main_program().random_seed = 90125
+    fluid.default_startup_program().random_seed = 90125
     opt = opt or fluid.optimizer.SGD(learning_rate=lr)
     opt.minimize(spec.loss)
     exe = fluid.Executor(fluid.CPUPlace())

@@ -1,0 +1,116 @@
+"""Multi-device tests on the 8-device virtual CPU mesh (SURVEY.md §4 item c:
+the analog of the reference's ParallelExecutor convergence tests
+``test_parallel_executor_*`` — same model single- vs multi-device, compare
+losses)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh
+
+import paddle_tpu as fluid
+from paddle_tpu import models
+from paddle_tpu.parallel.ring_attention import ring_attention
+from paddle_tpu.parallel.sharded_embedding import sharded_lookup
+from paddle_tpu.ops.flash_attention import mha_reference
+
+
+def _mesh(shape, axes):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, axes)
+
+
+def _train_mnist(compiled_mesh=None, steps=5):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        spec = models.mnist.mlp(hidden_sizes=(32,))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        prog = main
+        if compiled_mesh is not None:
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=spec.loss.name, mesh=compiled_mesh)
+        batch = spec.sample_batch(16, np.random.RandomState(5))
+        losses = []
+        for _ in range(steps):
+            lv, = exe.run(prog, feed=batch, fetch_list=[spec.loss])
+            losses.append(float(lv))
+    return losses
+
+
+def test_data_parallel_matches_single_device():
+    """Same model + batch: 8-way dp must track the single-device loss
+    (the reference's parallel-executor convergence criterion)."""
+    single = _train_mnist(None)
+    dp = _train_mnist(_mesh((8,), ("dp",)))
+    np.testing.assert_allclose(single, dp, rtol=2e-3, atol=2e-3)
+
+
+def test_dp_mp_transformer_converges():
+    mesh = _mesh((2, 2, 2), ("dp", "mp", "sp"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        spec = models.transformer.transformer_base(
+            src_vocab=64, trg_vocab=64, seq_len=16, d_model=32, d_ff=64,
+            n_head=2, n_layer=2, dropout_rate=0.0)
+        fluid.optimizer.Adam(learning_rate=3e-3).minimize(spec.loss)
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=spec.loss.name, mesh=mesh, sp_axis="sp")
+        batch = spec.sample_batch(4, np.random.RandomState(2))
+        first = last = None
+        for _ in range(6):
+            lv, = exe.run(cp, feed=batch, fetch_list=[spec.loss])
+            first = first if first is not None else float(lv)
+            last = float(lv)
+    assert last < first
+
+
+def test_ring_attention_matches_reference():
+    mesh = _mesh((4,), ("sp",))
+    rng = np.random.RandomState(0)
+    q = rng.randn(2, 2, 16, 8).astype("float32")
+    k = rng.randn(2, 2, 16, 8).astype("float32")
+    v = rng.randn(2, 2, 16, 8).astype("float32")
+    for causal in (False, True):
+        ref = mha_reference(jnp.array(q), jnp.array(k), jnp.array(v),
+                            causal=causal)
+        out = ring_attention(jnp.array(q), jnp.array(k), jnp.array(v),
+                             mesh, axis="sp", causal=causal)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_sharded_lookup_matches_take():
+    mesh = _mesh((4,), ("mp",))
+    rng = np.random.RandomState(1)
+    table = rng.randn(32, 6).astype("float32")
+    ids = rng.randint(0, 32, size=(5, 3)).astype("int32")
+    out = sharded_lookup(jnp.array(table), jnp.array(ids), mesh, axis="mp")
+    np.testing.assert_allclose(np.asarray(out), table[ids], rtol=1e-6)
+
+
+def test_distribute_transpiler_annotates():
+    from paddle_tpu.parallel.transpiler import DistributeTranspiler
+    from paddle_tpu.parallel.mesh import DistStrategy
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        spec = models.deepfm.deepfm(sparse_feature_dim=64, num_fields=4,
+                                    embedding_size=4, dense_dim=3,
+                                    hidden_sizes=(8,))
+    t = DistributeTranspiler()
+    t.transpile(trainer_id=0, program=main, trainers=8,
+                strategy=DistStrategy(dp=4, mp=2, sharded_embeddings=True))
+    trainer_prog = t.get_trainer_program()
+    assert trainer_prog is not None
+    emb = main._params.get("fm_emb")
+    assert emb is not None and emb.sharding is not None
