@@ -526,3 +526,23 @@ def _pixel_shuffle(env, op):
     out = x.reshape(n, c // (r * r), r, r, h, w)
     out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
     put(env, op.output("Out"), out)
+
+
+@register("moe_ffn")
+def _moe_ffn(env, op):
+    """Mixture-of-experts FFN (see ``parallel/moe.py``; new capability vs
+    the reference — SURVEY.md §2.5D lists expert parallelism as absent)."""
+    from ...parallel.moe import moe_ffn_apply
+
+    x = get(env, op.input("X"))
+    gate_w = get(env, op.input("GateW"))
+    w1 = get(env, op.input("W1"))
+    b1 = get(env, op.input("B1"))
+    w2 = get(env, op.input("W2"))
+    b2 = get(env, op.input("B2"))
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[op.attr("act", "relu")]
+    out, aux = moe_ffn_apply(
+        x, gate_w, w1, b1, w2, b2, k=op.attr("k", 2),
+        capacity_factor=op.attr("capacity_factor", 1.25), activation=act)
+    put(env, op.output("Out"), out)
+    put(env, op.output("AuxLoss"), aux)

@@ -10,7 +10,8 @@ import numpy as np
 
 from ..core.framework import Variable, convert_np_dtype
 from ..core.layer_helper import LayerHelper
-from ..core.initializer import ConstantInitializer, NormalInitializer, XavierInitializer
+from ..core.initializer import (ConstantInitializer, NormalInitializer,
+                                UniformInitializer, XavierInitializer)
 from ..core.param_attr import ParamAttr
 
 __all__ = [
@@ -35,6 +36,7 @@ __all__ = [
     "row_conv", "autoincreased_step_counter", "cos_sim",
     "split", "warpctc", "nce", "hsigmoid", "cumsum",
     "dynamic_lstm", "dynamic_gru", "lstm", "gru_unit",
+    "moe_ffn",
 ]
 
 
@@ -1119,6 +1121,53 @@ def gru_unit(input, hidden, size, param_attr=None, bias_attr=None,
                       "Bias": b},
                      {"Hidden": new_hidden}, {"origin_mode": origin_mode})
     return new_hidden
+
+
+def moe_ffn(input, num_experts, d_ff, k=2, capacity_factor=1.25, act="relu",
+            param_attr=None, name=None):
+    """Mixture-of-experts feed-forward block (new capability — the reference
+    has no MoE, SURVEY.md §2.5D). Expert weights are sharded over the ``ep``
+    mesh axis; GSPMD lowers dispatch to ICI all-to-alls (see
+    ``parallel/moe.py``). Returns ``(out, aux_loss)`` — add
+    ``scale(aux_loss, small_coeff)`` into the training loss for load
+    balancing."""
+    if act not in ("relu", "gelu"):
+        raise ValueError("moe_ffn act must be 'relu' or 'gelu', got %r"
+                         % (act,))
+    helper = LayerHelper("moe_ffn", param_attr=param_attr, name=name)
+    d = input.shape[-1]
+    dtype = _dtype(input)
+
+    def p(tag, shape, sharding=None, init=None):
+        return helper.create_parameter(
+            ParamAttr(name=None if name is None else name + "." + tag,
+                      initializer=init or XavierInitializer(),
+                      sharding=sharding),
+            shape=shape, dtype=dtype)
+
+    # per-expert Xavier fans ([D,F], not the stacked 3-D shape — the default
+    # initializer would read shape[2:] as a conv receptive field and start
+    # experts ~sqrt(D)x too small)
+    lim = (6.0 / (d + d_ff)) ** 0.5
+    xavier2d = UniformInitializer(-lim, lim)
+    gate_w = p("gate", [d, num_experts])
+    w1 = p("w1", [num_experts, d, d_ff], sharding=("ep", None, None),
+           init=xavier2d)
+    b1 = p("b1", [num_experts, d_ff], sharding=("ep", None))
+    w2 = p("w2", [num_experts, d_ff, d], sharding=("ep", None, None),
+           init=xavier2d)
+    b2 = p("b2", [num_experts, d], sharding=("ep", None))
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=input.shape)
+    aux = helper.create_variable_for_type_inference(dtype="float32",
+                                                    shape=())
+    helper.append_op(
+        "moe_ffn",
+        {"X": input, "GateW": gate_w, "W1": w1, "B1": b1, "W2": w2,
+         "B2": b2},
+        {"Out": out, "AuxLoss": aux},
+        {"k": k, "capacity_factor": capacity_factor, "act": act})
+    return out, aux
 
 
 def row_conv(input, future_context_size, param_attr=None, act=None,

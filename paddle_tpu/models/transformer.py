@@ -20,7 +20,13 @@ from .common import FeedSpec, ModelSpec
 __all__ = ["transformer_base", "transformer_flops_per_token"]
 
 
-def _ffn(x, d_model, d_ff, name):
+def _ffn(x, d_model, d_ff, name, moe_experts=0, moe_k=2, aux_losses=None):
+    if moe_experts:
+        out, aux = layers.moe_ffn(x, num_experts=moe_experts, d_ff=d_ff,
+                                  k=moe_k, name=name + "_moe")
+        if aux_losses is not None:
+            aux_losses.append(aux)
+        return out
     h = layers.fc(x, size=d_ff, num_flatten_dims=2, act="relu",
                   param_attr=ParamAttr(name=name + "_fc1.w",
                                        sharding=(None, "mp")),
@@ -59,7 +65,9 @@ def _embed(ids, pos, vocab_size, d_model, dropout_rate, name):
 
 def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
                      d_model=512, d_ff=2048, n_head=8, n_layer=6,
-                     dropout_rate=0.1, label_smooth_eps=0.1):
+                     dropout_rate=0.1, label_smooth_eps=0.1,
+                     moe_experts=0, moe_k=2):
+    aux_losses = []
     src = layers.data("src_ids", shape=[seq_len], dtype="int64")
     trg = layers.data("trg_ids", shape=[seq_len], dtype="int64")
     lbl = layers.data("lbl_ids", shape=[seq_len], dtype="int64")
@@ -76,7 +84,8 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
                 x, x, x, attn_bias=src_bias, d_model=d_model, n_head=n_head,
                 dropout_rate=dropout_rate, name=nm + "_attn"),
             dropout_rate, nm + "_attn")
-        enc = _prenorm(enc, lambda x: _ffn(x, d_model, d_ff, nm + "_ffn"),
+        enc = _prenorm(enc, lambda x: _ffn(x, d_model, d_ff, nm + "_ffn",
+                                           moe_experts, moe_k, aux_losses),
                        dropout_rate, nm + "_ffn")
     enc = layers.layer_norm(enc, begin_norm_axis=2)
 
@@ -93,7 +102,8 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
                 x, enc, enc, attn_bias=src_bias, d_model=d_model,
                 n_head=n_head, dropout_rate=dropout_rate, name=nm + "_cross"),
             dropout_rate, nm + "_cross")
-        dec = _prenorm(dec, lambda x: _ffn(x, d_model, d_ff, nm + "_ffn"),
+        dec = _prenorm(dec, lambda x: _ffn(x, d_model, d_ff, nm + "_ffn",
+                                           moe_experts, moe_k, aux_losses),
                        dropout_rate, nm + "_ffn")
     dec = layers.layer_norm(dec, begin_norm_axis=2)
 
@@ -116,6 +126,12 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
     tok_loss = layers.elementwise_mul(ce, mask)
     loss = layers.elementwise_div(layers.reduce_sum(tok_loss),
                                   layers.reduce_sum(mask))
+    if aux_losses:
+        total_aux = aux_losses[0]
+        for a in aux_losses[1:]:
+            total_aux = layers.elementwise_add(total_aux, a)
+        loss = layers.elementwise_add(
+            loss, layers.scale(total_aux, scale=0.01))
 
     return ModelSpec(
         loss,

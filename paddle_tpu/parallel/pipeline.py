@@ -1,0 +1,76 @@
+"""Pipeline parallelism: GPipe-style microbatched execution over a ``pp``
+mesh axis.
+
+Absent from the 2019 reference (SURVEY.md §2.5D: "Pipeline parallelism —
+no") but first-class here. TPU-native design: the L homogeneous stages'
+parameters are stacked on a leading axis sharded ``P('pp')`` (one stage per
+device); microbatches ride a ring of ``ppermute``s — device i runs stage i,
+passes activations to i+1, so after the fill phase all devices compute every
+step. Differentiable end-to-end (jax.grad through ppermute gives the 1F1B
+-equivalent reverse schedule automatically; XLA overlaps the ICI sends with
+stage compute).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["pipeline_apply", "stack_stage_params"]
+
+
+def stack_stage_params(param_list):
+    """Stack per-stage pytrees into one pytree with leading stage dim."""
+    return jax.tree_util.tree_map(
+        lambda *xs: jnp.stack(xs, axis=0), *param_list)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, mesh, axis="pp"):
+    """Run ``n_stages`` chained applications of ``stage_fn`` over the mesh.
+
+    Args:
+      stage_fn: (params_i, h) -> h, one pipeline stage (shape-preserving on
+        h — the classic homogeneous-stack formulation, e.g. transformer
+        blocks).
+      stacked_params: pytree with leading dim n_stages == mesh.shape[axis],
+        laid out ``P(axis)`` on the stage dim.
+      x: [n_micro, mb, ...] microbatched input (replicated).
+      Returns [n_micro, mb, ...] outputs after all stages.
+    """
+    from jax.experimental.shard_map import shard_map
+
+    n = mesh.shape[axis]
+    n_micro = x.shape[0]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(params, xs):
+        # params: stage dim sharded -> leading dim 1 locally
+        p = jax.tree_util.tree_map(lambda a: a[0], params)
+        idx = jax.lax.axis_index(axis)
+        zero = jnp.zeros_like(xs[0])
+        outs = jnp.zeros_like(xs)
+        carry = zero  # activation arriving from the previous stage
+        total = n_micro + n - 1
+        for t in range(total):  # static unroll: small (micro + stages - 1)
+            mb = min(t, n_micro - 1)
+            inp = jnp.where(idx == 0, xs[mb], carry)
+            # bubble steps (t >= n_micro on stage 0 etc.) compute garbage
+            # that is never collected — cheaper than predicating compute
+            out = stage_fn(p, inp)
+            if t >= n - 1:
+                # stage n-1 has just finished microbatch t-(n-1)
+                outs = jnp.where(
+                    (idx == n - 1)
+                    & (jnp.arange(n_micro) == t - (n - 1))[
+                        (slice(None),) + (None,) * (xs.ndim - 1)],
+                    out[None], outs)
+            carry = jax.lax.ppermute(out, axis, perm)
+        # every device holds outs only on the last stage; share them
+        return jax.lax.psum(outs, axis)
+
+    param_specs = jax.tree_util.tree_map(
+        lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params)
+    return shard_map(
+        local, mesh=mesh,
+        in_specs=(param_specs, P()),
+        out_specs=P(),
+    )(stacked_params, x)
