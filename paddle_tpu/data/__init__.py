@@ -12,5 +12,5 @@ from . import datasets  # noqa: F401
 from .feeder import DataFeeder  # noqa: F401
 from .reader import (  # noqa: F401
     shuffle, batch, buffered, map_readers, chain, compose, firstn, cache,
-    xmap_readers, multiprocess_reader)
+    xmap_readers, multiprocess_reader, recordio_reader, recordio_writer)
 from .py_reader import py_reader, PyReader  # noqa: F401

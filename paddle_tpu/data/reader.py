@@ -10,7 +10,7 @@ import threading
 
 __all__ = ["shuffle", "batch", "buffered", "map_readers", "chain", "compose",
            "firstn", "cache", "xmap_readers", "multiprocess_reader",
-           "multi_pass"]
+           "multi_pass", "recordio_reader", "recordio_writer"]
 
 
 def shuffle(reader, buf_size):
@@ -194,3 +194,44 @@ def cache(reader):
                 yield item
 
     return impl
+
+
+def recordio_reader(files, n_threads=2, n_epochs=1, capacity=512):
+    """Reader creator streaming raw records from recordio files through the
+    NATIVE prefetch queue (C++ reader threads + bounded MPMC queue — the
+    ``open_files``/double-buffer capability, ref
+    ``operators/reader/open_files_op.cc``/``buffered_reader.cc``). Records
+    are bytes; compose with ``map_readers`` to decode."""
+    if isinstance(files, str):
+        files = [files]
+    import os
+    missing = [f for f in files if not os.path.isfile(f)]
+    if missing:
+        # the native worker skips unopenable files silently (robustness
+        # against transient loss mid-train); fail fast on a bad config here
+        raise IOError("recordio files not found: %s" % (missing,))
+
+    def reader():
+        from .. import native
+
+        with native.PrefetchQueue(capacity=capacity) as q:
+            q.start_files(list(files), n_threads=n_threads,
+                          n_epochs=n_epochs)
+            for rec in q:
+                yield rec
+
+    return reader
+
+
+def recordio_writer(path, reader, max_chunk_records=1024,
+                    serializer=None):
+    """Materialize a reader's records into a recordio file (ref
+    ``recordio_writer.py`` convert_reader_to_recordio_file)."""
+    from .. import native
+
+    n = 0
+    with native.RecordIOWriter(path, max_chunk_records) as w:
+        for item in reader():
+            w.write(serializer(item) if serializer else item)
+            n += 1
+    return n

@@ -1,0 +1,207 @@
+"""ctypes bindings for the native runtime (``native/*.cc``).
+
+The reference implements its data plane in C++ (recordio
+``paddle/fluid/recordio/``, reader prefetch ops
+``operators/reader/buffered_reader.cc``); this module loads the same
+capabilities from ``libpaddle_tpu_native.so``, building it on first use with
+g++ (no pybind11 in the image — plain C ABI + ctypes). Falls back to pure
+Python (``native_available() == False``) if no toolchain is present.
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpaddle_tpu_native.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _build():
+    srcs = [os.path.join(_NATIVE_DIR, s)
+            for s in ("recordio.cc", "prefetch_queue.cc")]
+    cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-pthread",
+           *srcs, "-o", _LIB_PATH]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def _load():
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        try:
+            srcs = [os.path.join(_NATIVE_DIR, s)
+                    for s in ("recordio.cc", "prefetch_queue.cc")]
+            if (not os.path.exists(_LIB_PATH)
+                    or any(os.path.getmtime(s) > os.path.getmtime(_LIB_PATH)
+                           for s in srcs)):
+                _build()
+            lib = ctypes.CDLL(_LIB_PATH)
+        except (OSError, subprocess.CalledProcessError):
+            _build_failed = True
+            return None
+        lib.recordio_writer_open.restype = ctypes.c_void_p
+        lib.recordio_writer_open.argtypes = [ctypes.c_char_p,
+                                             ctypes.c_uint32]
+        lib.recordio_writer_write.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p,
+                                              ctypes.c_uint32]
+        lib.recordio_writer_close.restype = ctypes.c_int
+        lib.recordio_writer_close.argtypes = [ctypes.c_void_p]
+        lib.recordio_reader_open.restype = ctypes.c_void_p
+        lib.recordio_reader_open.argtypes = [ctypes.c_char_p]
+        lib.recordio_reader_next.restype = ctypes.c_int64
+        lib.recordio_reader_next.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p,
+                                             ctypes.c_int64]
+        lib.recordio_reader_close.argtypes = [ctypes.c_void_p]
+        lib.prefetch_queue_create.restype = ctypes.c_void_p
+        lib.prefetch_queue_create.argtypes = [ctypes.c_uint32]
+        lib.prefetch_queue_start.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_char_p, ctypes.c_int,
+                                             ctypes.c_int]
+        lib.prefetch_queue_push.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.c_uint32]
+        lib.prefetch_queue_pop.restype = ctypes.c_int64
+        lib.prefetch_queue_pop.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                           ctypes.c_int64]
+        lib.prefetch_queue_size.restype = ctypes.c_int64
+        lib.prefetch_queue_size.argtypes = [ctypes.c_void_p]
+        lib.prefetch_queue_mark_done.argtypes = [ctypes.c_void_p]
+        lib.prefetch_queue_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available():
+    return _load() is not None
+
+
+class RecordIOWriter:
+    """Chunked CRC-checked record file writer (ref ``recordio/writer.h``)."""
+
+    def __init__(self, path, max_chunk_records=1024):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable; use "
+                               "data.reader fallbacks")
+        self._lib = lib
+        self._h = lib.recordio_writer_open(path.encode(), max_chunk_records)
+        if not self._h:
+            raise IOError("cannot open %s for writing" % path)
+
+    def write(self, data: bytes):
+        self._lib.recordio_writer_write(self._h, data, len(data))
+
+    def close(self):
+        if self._h:
+            ok = self._lib.recordio_writer_close(self._h)
+            self._h = None
+            if not ok:
+                raise IOError("recordio write failed (disk full?); file "
+                              "is incomplete")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class RecordIOReader:
+    """Sequential reader; corrupt chunks are skipped (ref scanner.h)."""
+
+    def __init__(self, path, buf_size=1 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.recordio_reader_open(path.encode())
+        if not self._h:
+            raise IOError("cannot open %s" % path)
+        self._buf = ctypes.create_string_buffer(buf_size)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = self._lib.recordio_reader_next(self._h, self._buf,
+                                           len(self._buf))
+        if n == -1:
+            raise StopIteration
+        if n < -1:
+            self._buf = ctypes.create_string_buffer(2 * (-int(n) - 2))
+            return self.__next__()
+        return self._buf.raw[:n]
+
+    def close(self):
+        if self._h:
+            self._lib.recordio_reader_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+class PrefetchQueue:
+    """Bounded MPMC record queue with native reader threads — the
+    double-buffer/open_files prefetch capability
+    (ref ``operators/reader/buffered_reader.cc``)."""
+
+    def __init__(self, capacity=512, buf_size=1 << 20):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.prefetch_queue_create(capacity)
+        self._buf = ctypes.create_string_buffer(buf_size)
+
+    def start_files(self, files, n_threads=2, n_epochs=1):
+        self._lib.prefetch_queue_start(
+            self._h, "\n".join(files).encode(), n_threads, n_epochs)
+
+    def push(self, data: bytes):
+        return bool(self._lib.prefetch_queue_push(self._h, data, len(data)))
+
+    def mark_done(self):
+        self._lib.prefetch_queue_mark_done(self._h)
+
+    def pop(self):
+        """Blocking pop; None when the stream is exhausted."""
+        n = self._lib.prefetch_queue_pop(self._h, self._buf, len(self._buf))
+        if n == -1:
+            return None
+        if n < -1:
+            self._buf = ctypes.create_string_buffer(2 * (-int(n) - 2))
+            return self.pop()
+        return self._buf.raw[:n]
+
+    def qsize(self):
+        return int(self._lib.prefetch_queue_size(self._h))
+
+    def __iter__(self):
+        while True:
+            rec = self.pop()
+            if rec is None:
+                return
+            yield rec
+
+    def close(self):
+        if self._h:
+            self._lib.prefetch_queue_destroy(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        self.close()
