@@ -211,6 +211,32 @@ class Executor:
                                 if v.persistable})
         state_in_names = tuple(n for n in persist_names if n in scope)
 
+        # multi-host mesh (jax.distributed): each process feeds its LOCAL
+        # batch shard (the reference's per-trainer reader semantics) and the
+        # executor assembles global arrays. State must be identical across
+        # processes (set program.random_seed) — it's treated as replicated
+        # unless annotated.
+        multiproc = mesh is not None and any(
+            d.process_index != jax.process_index()
+            for d in mesh.devices.flat)
+        if multiproc:
+            in_sh, _ = self._mesh_shardings(
+                program, tuple(sorted(feed_arrays)), tuple(fetch_names),
+                state_in_names, persist_names, mesh, dp_axis, sp_axis)
+            state_sh, feed_sh, repl_sh = in_sh
+
+            def globalize(sharding, arr):
+                if isinstance(arr, jax.Array) and arr.sharding == sharding:
+                    return arr
+                return jax.make_array_from_process_local_data(
+                    sharding, np.asarray(arr))
+
+            feed_arrays = {n: globalize(feed_sh[n], a)
+                           for n, a in feed_arrays.items()}
+            for n in state_in_names:
+                scope.set(n, globalize(state_sh[n], scope.get(n)))
+            scope.set(RNG_KEY, globalize(repl_sh, scope.get(RNG_KEY)))
+
         feed_sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
@@ -240,18 +266,14 @@ class Executor:
         self._cache.clear()
 
     # -- compilation --------------------------------------------------------
-    def _compile(self, program, feed_names, fetch_names, state_in_names,
-                 persist_names, mesh, dp_axis, sp_axis=None):
-        step = build_step_fn(program, fetch_names, persist_names)
-        donate = (0,)
-        if mesh is None:
-            return jax.jit(step, donate_argnums=donate)
-
-        # data-parallel / sharded execution via pjit over the mesh:
-        # feed tensors shard along the batch axis (dp), parameters follow
-        # their Parameter.sharding spec (replicated by default). XLA/GSPMD
-        # inserts the gradient all-reduces — replacing the reference's
-        # multi_devices_graph_pass + NCCL allreduce op handles.
+    def _mesh_shardings(self, program, feed_names, fetch_names,
+                        state_in_names, persist_names, mesh, dp_axis,
+                        sp_axis):
+        """Sharding layout of a (state, feed, rng) -> (fetch, state, rng)
+        step over ``mesh``: feeds shard on dp (+sp for sequence feeds),
+        persistables follow their annotated specs. This is the declarative
+        replacement for the reference's multi_devices_graph_pass + NCCL
+        allreduce op-handles — GSPMD inserts the collectives."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         mesh_axes = set(mesh.axis_names)
@@ -312,6 +334,17 @@ class Executor:
             tuple(repl for _ in fetch_names),
             {n: param_shardings.get(n, repl) for n in out_state},
             repl)
+        return in_shardings, out_shardings
+
+    def _compile(self, program, feed_names, fetch_names, state_in_names,
+                 persist_names, mesh, dp_axis, sp_axis=None):
+        step = build_step_fn(program, fetch_names, persist_names)
+        donate = (0,)
+        if mesh is None:
+            return jax.jit(step, donate_argnums=donate)
+        in_shardings, out_shardings = self._mesh_shardings(
+            program, feed_names, fetch_names, state_in_names, persist_names,
+            mesh, dp_axis, sp_axis)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=in_shardings,
                        out_shardings=out_shardings)

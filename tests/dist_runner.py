@@ -1,0 +1,54 @@
+"""Subprocess entry for multi-process distributed tests — the analog of the
+reference's ``test_dist_base.py`` trainer scripts (``TestDistRunnerBase``):
+each process jax.distributed-initializes against a localhost coordinator,
+builds the SAME model with a fixed seed, feeds its LOCAL shard of a
+deterministic global batch, and prints the per-step losses as JSON."""
+
+import json
+import os
+import sys
+
+
+def main():
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    steps = int(sys.argv[4])
+
+    import jax
+    jax.distributed.initialize("127.0.0.1:%s" % port, num_processes=nproc,
+                               process_id=pid)
+    import numpy as np
+    from jax.sharding import Mesh
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+
+    main_p, startup = fluid.Program(), fluid.Program()
+    main_p.random_seed = startup.random_seed = 1234
+    with fluid.program_guard(main_p, startup):
+        spec = models.mnist.mlp(hidden_sizes=(32,))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(spec.loss)
+
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        cp = fluid.CompiledProgram(main_p).with_data_parallel(
+            loss_name=spec.loss.name, mesh=mesh)
+        global_batch = spec.sample_batch(16, np.random.RandomState(77))
+        per = 16 // nproc
+        local = {k: v[pid * per:(pid + 1) * per]
+                 for k, v in global_batch.items()}
+        losses = []
+        for _ in range(steps):
+            lv, = exe.run(cp, feed=local, fetch_list=[spec.loss])
+            losses.append(float(np.asarray(lv)))
+    print("DIST_LOSSES " + json.dumps(losses), flush=True)
+
+
+if __name__ == "__main__":
+    main()
