@@ -105,7 +105,7 @@ class Queue {
   // an epoch ends and the list restarts (n_epochs<0 = loop forever)
   bool ClaimFile(std::string* path) {
     std::lock_guard<std::mutex> g(mu_);
-    if (stop_) return false;
+    if (stop_ || files_.empty()) return false;
     if (next_file_ >= files_.size()) {
       if (epochs_left_ > 0) --epochs_left_;
       if (epochs_left_ == 0) return false;

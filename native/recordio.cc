@@ -119,23 +119,42 @@ class Reader {
   }
 
  private:
+  // A corrupt header can carry an intact magic but a garbage length;
+  // anything above this cap is treated as lost framing, not an allocation.
+  static constexpr uint32_t kMaxPayload = 1u << 30;
+
   bool LoadChunk() {
     records_.clear();
     idx_ = 0;
     for (;;) {
+      long chunk_start = ftell(f_);
+      if (chunk_start < 0) return false;
       uint32_t header[4];
       if (fread(header, sizeof(header), 1, f_) != 1) return false;  // EOF
       if (header[0] != kMagic) {
         // lost framing: scan forward one byte at a time for the magic
-        if (fseek(f_, -static_cast<long>(sizeof(header)) + 1, SEEK_CUR))
-          return false;
+        if (fseek(f_, chunk_start + 1, SEEK_SET)) return false;
         continue;
       }
       uint32_t payload_len = header[2];
+      if (payload_len == 0 || payload_len > kMaxPayload) {
+        if (fseek(f_, chunk_start + 1, SEEK_SET)) return false;
+        continue;
+      }
       std::vector<uint8_t> payload(payload_len);
-      if (fread(payload.data(), 1, payload_len, f_) != payload_len)
-        return false;  // truncated tail
-      if (Crc32(payload.data(), payload_len) != header[3]) continue;  // skip
+      if (fread(payload.data(), 1, payload_len, f_) != payload_len) {
+        // short read: either the true tail (the rescan hits EOF below) or
+        // a corrupt length that ran past valid chunks — rescan, don't
+        // silently drop the rest of the file
+        if (fseek(f_, chunk_start + 1, SEEK_SET)) return false;
+        continue;
+      }
+      if (Crc32(payload.data(), payload_len) != header[3]) {
+        // corrupt payload: resume the magic scan past this header so any
+        // intact chunk inside the damaged span is still recovered
+        if (fseek(f_, chunk_start + 1, SEEK_SET)) return false;
+        continue;
+      }
       // parse records
       size_t off = 0;
       bool good = true;

@@ -43,14 +43,39 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     fwd_ops = list(gb.ops)
     params = _collect_params(prog, parameter_list, no_grad_set)
     wrt_names = [p.name for p in params]
-    grad_vars = [
-        gb.create_var(name=grad_var_name(p.name), shape=p.shape,
-                      dtype=str(p.dtype))
-        for p in params
-    ]
+
+    # SelectedRows parity: params marked ``is_sparse_grad`` (embedding with
+    # is_sparse=True) get a (rows, values) gradient pair instead of a dense
+    # full-table grad — ref ``lookup_table_op.cc`` grad emitting SelectedRows.
+    # A table consumed by anything other than sparse lookups (e.g. weight
+    # tying into an output projection) falls back to the dense grad.
+    def _sparse_ok(p):
+        if not getattr(p, "is_sparse_grad", False):
+            return False
+        uses = [o for o in fwd_ops if p.name in o.input_arg_names]
+        return uses and all(
+            o.type in ("lookup_table", "sharded_lookup_table")
+            and o.attr("is_sparse", True)
+            and o.input("W") is not None and o.input("W").name == p.name
+            for o in uses)
+
+    sparse_names = [p.name for p in params if _sparse_ok(p)]
+    grad_vars = []
+    rows_vars = []
+    for p in params:
+        gv = gb.create_var(name=grad_var_name(p.name), shape=p.shape,
+                           dtype=str(p.dtype))
+        if p.name in sparse_names:
+            rv = gb.create_var(name=grad_var_name(p.name) + "@ROWS",
+                               shape=None, dtype="int32")
+            gv.sparse_rows_var = rv
+            rows_vars.append(rv)
+        grad_vars.append(gv)
     op = gb.append_op(
-        "autodiff", {"Loss": loss}, {"Grads": grad_vars},
+        "autodiff", {"Loss": loss},
+        {"Grads": grad_vars, "SparseRows": rows_vars},
         {"fwd_ops": fwd_ops, "wrt_names": wrt_names,
+         "sparse_wrt_names": sparse_names,
          "grad_callback": None,
          "remat": bool(checkpoints)})
     prog._backward_ops.append(op)

@@ -61,18 +61,25 @@ class CompiledProgram:
         self._sp_axis = None
         self._build_strategy = None
         self._exec_strategy = None
+        self._seq_feeds = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
                            places=None, mesh=None, dp_axis="dp",
-                           sp_axis=None):
+                           sp_axis=None, sequence_feeds=None):
         """Shard the batch over a device mesh axis (ref
         ``compiler.py:116``). ``mesh`` defaults to a 1-D mesh over all local
-        devices — the analog of ParallelExecutor claiming all visible GPUs."""
+        devices — the analog of ParallelExecutor claiming all visible GPUs.
+
+        ``sequence_feeds``: with ``sp_axis`` set, the feed names whose dim 1
+        is the sequence axis to shard. Default None falls back to a
+        longest-dim-1 heuristic (a warning names the classified feeds)."""
         self._build_strategy = build_strategy or BuildStrategy()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._dp_axis = dp_axis
         self._sp_axis = sp_axis
+        self._seq_feeds = (tuple(sorted(sequence_feeds))
+                           if sequence_feeds is not None else None)
         self._mesh = mesh
         self._places = places
         return self

@@ -147,13 +147,13 @@ def build_step_fn(program, fetch_names, persist_names):
         env.update(feed)
         env[RNG_KEY] = rng
         env[RNG0_KEY] = rng
-        prev_amp = AMP["enabled"]
-        AMP["enabled"] = amp  # trace-time flag: fwd + autodiff replay
+        prev_amp = AMP.enabled
+        AMP.enabled = amp  # trace-time flag: fwd + autodiff replay
         try:
             for op in ops:
                 run_op(env, op)
         finally:
-            AMP["enabled"] = prev_amp
+            AMP.enabled = prev_amp
         fetches = tuple(env[n] for n in fetch_names)
         new_state = {n: env[n] for n in persist_set if n in env}
         return fetches, new_state, env[RNG_KEY]
@@ -177,10 +177,12 @@ class Executor:
         mesh = None
         dp_axis = None
         sp_axis = None
+        seq_feeds = None
         if isinstance(program, CompiledProgram):
             mesh = program._resolve_mesh()
             dp_axis = program._dp_axis
             sp_axis = program._sp_axis
+            seq_feeds = program._seq_feeds
             program = program._program
         if scope is None:
             scope = global_scope()
@@ -222,7 +224,8 @@ class Executor:
         if multiproc:
             in_sh, _ = self._mesh_shardings(
                 program, tuple(sorted(feed_arrays)), tuple(fetch_names),
-                state_in_names, persist_names, mesh, dp_axis, sp_axis)
+                state_in_names, persist_names, mesh, dp_axis, sp_axis,
+                seq_feeds)
             state_sh, feed_sh, repl_sh = in_sh
 
             def globalize(sharding, arr):
@@ -240,12 +243,12 @@ class Executor:
         feed_sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               state_in_names, id(scope), mesh, dp_axis, sp_axis)
+               state_in_names, id(scope), mesh, dp_axis, sp_axis, seq_feeds)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._compile(program, tuple(sorted(feed_arrays)),
                                   fetch_names, state_in_names, persist_names,
-                                  mesh, dp_axis, sp_axis)
+                                  mesh, dp_axis, sp_axis, seq_feeds)
             if use_program_cache:
                 self._cache[key] = entry
         jfn = entry
@@ -268,7 +271,7 @@ class Executor:
     # -- compilation --------------------------------------------------------
     def _mesh_shardings(self, program, feed_names, fetch_names,
                         state_in_names, persist_names, mesh, dp_axis,
-                        sp_axis):
+                        sp_axis, seq_feeds=None):
         """Sharding layout of a (state, feed, rng) -> (fetch, state, rng)
         step over ``mesh``: feeds shard on dp (+sp for sequence feeds),
         persistables follow their annotated specs. This is the declarative
@@ -298,12 +301,15 @@ class Executor:
 
         # sequence-parallel feeds: axis 1 of [B,S,...] sequence feeds -> sp
         # (ring-attention-style context sharding; GSPMD all-gathers where an
-        # op needs the full sequence). The sequence feeds are those whose
-        # dim 1 equals the longest candidate dim (the model's seq length) —
-        # labels [B,1] / field-id feeds stay dp-only.
+        # op needs the full sequence). Callers name the sequence feeds
+        # explicitly via with_data_parallel(sequence_feeds=...); without an
+        # annotation the feeds whose dim 1 equals the longest candidate dim
+        # (the model's seq length) are classified, with a warning naming
+        # them — labels [B,1] / field-id feeds stay dp-only.
         gb = program.global_block()
-        seq_dim = None
-        if sp_size is not None:
+        sp_names = set(seq_feeds or ())
+        if sp_size is not None and seq_feeds is None:
+            seq_dim = None
             dims = [gb.var(n).shape[1] for n in feed_names
                     if gb.has_var(n) and gb.var(n).shape is not None
                     and len(gb.var(n).shape) >= 2 and gb.var(n).shape[1] > 1]
@@ -311,11 +317,20 @@ class Executor:
                 seq_dim = max(dims)
                 if seq_dim % sp_size != 0:
                     seq_dim = None
+            if seq_dim is not None:
+                for n in feed_names:
+                    shp = gb.var(n).shape if gb.has_var(n) else None
+                    if shp is not None and len(shp) >= 2 and shp[1] == seq_dim:
+                        sp_names.add(n)
+            if sp_names:
+                warnings.warn(
+                    "sequence-parallel heuristic sharded feeds %s over the "
+                    "'%s' axis; pass sequence_feeds=[...] to "
+                    "with_data_parallel to choose explicitly"
+                    % (sorted(sp_names), sp_axis))
 
         def feed_spec(name):
-            shp = gb.var(name).shape if gb.has_var(name) else None
-            if (seq_dim is not None and shp is not None and len(shp) >= 2
-                    and shp[1] == seq_dim):
+            if name in sp_names:
                 return NamedSharding(mesh, P(dp_axis, sp_axis))
             return NamedSharding(mesh, P(dp_axis))
 
@@ -337,14 +352,14 @@ class Executor:
         return in_shardings, out_shardings
 
     def _compile(self, program, feed_names, fetch_names, state_in_names,
-                 persist_names, mesh, dp_axis, sp_axis=None):
+                 persist_names, mesh, dp_axis, sp_axis=None, seq_feeds=None):
         step = build_step_fn(program, fetch_names, persist_names)
         donate = (0,)
         if mesh is None:
             return jax.jit(step, donate_argnums=donate)
         in_shardings, out_shardings = self._mesh_shardings(
             program, feed_names, fetch_names, state_in_names, persist_names,
-            mesh, dp_axis, sp_axis)
+            mesh, dp_axis, sp_axis, seq_feeds)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=in_shardings,
                        out_shardings=out_shardings)

@@ -10,6 +10,8 @@ op list is traced into a single XLA computation, so "kernel dispatch" and
 the reference's per-op kernel launch + ir fuse passes.
 """
 
+import threading
+
 import jax
 import jax.numpy as jnp
 
@@ -88,6 +90,24 @@ def next_rng(env):
     return sub
 
 
+def merge_sparse_rows(rows, vals, sentinel):
+    """Merge duplicate rows of a (rows, values) sparse grad at static length:
+    each real row appears once carrying the summed value; every duplicate
+    slot holds ``sentinel`` (an out-of-range row) with a ZERO value, so both
+    scatters (which drop out-of-range rows) and norms (which must not count
+    a row twice) are exact. Ref ``math/selected_rows_functor.cc`` MergeAdd."""
+    order = jnp.argsort(rows)
+    r = rows[order]
+    v = vals[order]
+    is_start = jnp.concatenate([jnp.ones((1,), bool), r[1:] != r[:-1]])
+    seg = jnp.cumsum(is_start) - 1
+    totals = jax.ops.segment_sum(v, seg, num_segments=r.shape[0])
+    mask = is_start.reshape((-1,) + (1,) * (v.ndim - 1))
+    vals_u = jnp.where(mask, totals[seg], 0)
+    rows_u = jnp.where(is_start, r, sentinel)
+    return rows_u, vals_u
+
+
 def bcast_y(x, y, axis):
     """Reference elementwise broadcast semantics: y's shape aligns to x
     starting at ``axis`` (ref ``operators/elementwise/elementwise_op.h``).
@@ -115,16 +135,22 @@ def bcast_y(x, y, axis):
 # (``executor.build_step_fn``), so forward AND the autodiff replay see it.
 # ---------------------------------------------------------------------------
 
-AMP = {"enabled": False}
+class _AmpState(threading.local):
+    """Per-thread so concurrent traces (two executors compiling in parallel
+    threads) cannot cross-contaminate each other's precision."""
+    enabled = False
+
+
+AMP = _AmpState()
 
 
 def amp_enabled():
-    return AMP["enabled"]
+    return AMP.enabled
 
 
 def mxu_cast(*xs):
     """Cast float32 matmul/conv operands to bf16 when AMP is on."""
-    if not AMP["enabled"]:
+    if not AMP.enabled:
         return xs if len(xs) > 1 else xs[0]
     out = tuple(
         x.astype(jnp.bfloat16)
@@ -136,6 +162,6 @@ def mxu_cast(*xs):
 
 def mxu_acc_dtype(x):
     """Accumulation dtype for MXU ops: fp32 outputs even for bf16 inputs."""
-    if AMP["enabled"]:
+    if AMP.enabled:
         return jnp.float32
     return None

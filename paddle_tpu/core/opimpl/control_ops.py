@@ -23,16 +23,37 @@ from ..op_registry import register, get, put, run_op, RNG_KEY, RNG0_KEY
 def _autodiff(env, op):
     fwd_ops = op.attr("fwd_ops")
     wrt_names = op.attr("wrt_names")
+    sparse_names = set(op.attr("sparse_wrt_names") or ())
     loss_var = op.input("Loss")
     rng0 = env.get(RNG0_KEY)
 
-    def loss_fn(wrt_vals):
+    # SelectedRows-parity sparse grads: instead of differentiating w.r.t. a
+    # sparse table (whose gather-vjp is a full-table scatter), differentiate
+    # w.r.t. a zero delta ADDED to each lookup's output — d loss/d delta is
+    # exactly the per-row cotangent, and (ids, cotangent) is the sparse
+    # (rows, values) gradient (ref ``lookup_table_op.cc`` grad kernel).
+    sites = {}  # fwd idx -> (delta key, table, out name, ids name, pad idx)
+    for i, f in enumerate(fwd_ops):
+        if (f.type in ("lookup_table", "sharded_lookup_table")
+                and f.input("W") is not None
+                and f.input("W").name in sparse_names):
+            sites[i] = ("@delta@%d" % i, f.input("W").name,
+                        f.output("Out").name, f.input("Ids").name,
+                        f.attr("padding_idx", -1))
+
+    dense_wrt = [n for n in wrt_names if n not in sparse_names]
+
+    def loss_fn(args):
         local = dict(env)
-        local.update(wrt_vals)
+        local.update(args["w"])
         if rng0 is not None:
             local[RNG_KEY] = rng0
-        for f in fwd_ops:
+        for i, f in enumerate(fwd_ops):
             run_op(local, f)
+            site = sites.get(i)
+            if site is not None:
+                out_name = site[2]
+                local[out_name] = local[out_name] + args["d"][site[0]]
         return jnp.sum(local[loss_var.name])
 
     if op.attr("remat"):
@@ -40,16 +61,50 @@ def _autodiff(env, op):
         # recompute forward activations in the backward instead of saving
         loss_fn = jax.checkpoint(loss_fn)
 
-    wrt_vals = {n: env[n] for n in wrt_names}
-    grads = jax.grad(loss_fn)(wrt_vals)
+    # delta shapes come from the already-traced forward outputs in env
+    deltas = {key: jnp.zeros_like(env[out_name])
+              for key, _, out_name, _, _ in sites.values()}
+    args = {"w": {n: env[n] for n in dense_wrt}, "d": deltas}
+    grads = jax.grad(loss_fn)(args)
+
+    callback = op.attr("grad_callback")
     out_vars = op.output_list("Grads")
     assert len(out_vars) == len(wrt_names)
     for name, v in zip(wrt_names, out_vars):
-        g = grads[name]
-        callback = op.attr("grad_callback")
-        if callback is not None:
-            g = callback(name, g)
-        put(env, v, g)
+        if name in sparse_names:
+            from ..op_registry import merge_sparse_rows
+
+            vocab, emb_dim = env[name].shape[0], env[name].shape[-1]
+            rows_parts, val_parts = [], []
+            for key, table, out_name, ids_name, pad in sites.values():
+                if table != name:
+                    continue
+                ids = env[ids_name].reshape(-1).astype(jnp.int32)
+                vals = grads["d"][key].reshape(-1, emb_dim)
+                if pad is not None and pad >= 0:
+                    # the padding row's grad is zero (the lookup masks its
+                    # output); park padded slots on the dropped sentinel
+                    padded = ids == pad
+                    ids = jnp.where(padded, vocab, ids)
+                    vals = jnp.where(padded[:, None], 0, vals)
+                rows_parts.append(ids)
+                val_parts.append(vals)
+            rows = jnp.concatenate(rows_parts, axis=0)
+            g = jnp.concatenate(val_parts, axis=0)
+            # merge duplicates once here so downstream clip/decay ops see
+            # each row exactly once (zeros elsewhere) and norms are exact
+            rows, g = merge_sparse_rows(rows, g, vocab)
+            if callback is not None:
+                g = callback(name, g)
+            put(env, v, g)
+            rv = getattr(v, "sparse_rows_var", None)
+            if rv is not None:
+                env[rv.name] = rows
+        else:
+            g = grads["w"][name]
+            if callback is not None:
+                g = callback(name, g)
+            put(env, v, g)
 
 
 @register("autodiff_vjp")

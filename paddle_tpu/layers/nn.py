@@ -110,6 +110,11 @@ def embedding(input, size, is_sparse=False, is_distributed=False,
     helper = LayerHelper("embedding", param_attr=param_attr, name=name)
     w = helper.create_parameter(helper.param_attr, shape=list(size), dtype=dtype)
     w.is_distributed = is_distributed
+    if is_sparse:
+        # SelectedRows parity (ref ``framework/selected_rows.h:32``): the
+        # gradient materializes as (rows, values) and optimizers take their
+        # scatter-update branch instead of a full-table dense update.
+        w.is_sparse_grad = True
     in_shape = input.shape
     base = in_shape[:-1] if (in_shape and in_shape[-1] == 1) else in_shape
     out = helper.create_variable_for_type_inference(

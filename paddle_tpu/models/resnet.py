@@ -50,7 +50,7 @@ def _layer_warp(block_fn, x, ch_out, count, stride):
 
 def resnet_imagenet(depth=50, class_num=1000, image_shape=(3, 224, 224)):
     """Bottleneck ResNet-{50,101,152} on ImageNet-shaped input."""
-    cfg = {18: ([2, 2, 2, 1], _basicblock),
+    cfg = {18: ([2, 2, 2, 2], _basicblock),
            34: ([3, 4, 6, 3], _basicblock),
            50: ([3, 4, 6, 3], _bottleneck),
            101: ([3, 4, 23, 3], _bottleneck),

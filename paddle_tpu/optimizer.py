@@ -101,6 +101,11 @@ class Optimizer:
             with framework.name_scope("optimizer"):
                 op = self._append_optimize_op(prog.global_block(), (p, g))
                 op.attrs["is_optimizer_op"] = True
+                rows = getattr(g, "sparse_rows_var", None)
+                if rows is not None:
+                    # SelectedRows-style grad: the update op takes its
+                    # scatter branch (ref sparse optimizer kernels)
+                    op.inputs["GradRows"] = [rows]
                 ops.append(op)
         self._finish_update(prog.global_block(), params_grads)
         return ops

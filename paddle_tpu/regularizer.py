@@ -13,11 +13,24 @@ class WeightDecayRegularizer:
         raise NotImplementedError
 
 
+def _append_sparse_decay(param, grad, block, coeff, mode):
+    """Row-wise decay on the touched rows of a sparse (rows, values) grad —
+    ref regularizer.py SelectedRows branch (merge + decay on rows)."""
+    block.append_op(
+        "sparse_decay",
+        {"Grad": grad, "Rows": grad.sparse_rows_var, "Param": param},
+        {"Out": grad}, {"coeff": coeff, "mode": mode})
+    return grad
+
+
 class L2DecayRegularizer(WeightDecayRegularizer):
     def __init__(self, regularization_coeff=0.0):
         self._coeff = regularization_coeff
 
     def __call__(self, param, grad, block):
+        if getattr(grad, "sparse_rows_var", None) is not None:
+            return _append_sparse_decay(param, grad, block, self._coeff,
+                                        "l2")
         decay = block.create_var(shape=param.shape, dtype=str(param.dtype))
         block.append_op("scale", {"X": param}, {"Out": decay},
                         {"scale": self._coeff})
@@ -31,6 +44,9 @@ class L1DecayRegularizer(WeightDecayRegularizer):
         self._coeff = regularization_coeff
 
     def __call__(self, param, grad, block):
+        if getattr(grad, "sparse_rows_var", None) is not None:
+            return _append_sparse_decay(param, grad, block, self._coeff,
+                                        "l1")
         sign = block.create_var(shape=param.shape, dtype=str(param.dtype))
         block.append_op("sign", {"X": param}, {"Out": sign}, {})
         decay = block.create_var(shape=param.shape, dtype=str(param.dtype))

@@ -102,3 +102,32 @@ def test_recordio_reader_composes_with_decorators(tmp_path):
     assert len(batches) == 2 and len(batches[0]) == 5
     got = sorted(int(x[0]) for b in batches for x in b)
     assert got == list(range(10))
+
+
+def test_recordio_corrupt_length_rescans(tmp_path):
+    """A corrupted chunk-length field must not eat the rest of the file or
+    trigger an unbounded allocation — the reader resumes the byte-wise magic
+    scan and recovers every later chunk."""
+    path = str(tmp_path / "len.recordio")
+    recs = [("rec%04d" % i).encode() for i in range(32)]
+    _write(path, recs, chunk=8)  # 4 chunks of 8
+    data = bytearray(open(path, "rb").read())
+    # locate the SECOND chunk header by scanning for the magic and smash its
+    # payload_len field (bytes 8..12 of the header) to a huge value
+    magic = data[:4]
+    second = data.find(magic, 4)
+    assert second > 0
+    data[second + 8:second + 12] = (0xFFFFFFF0).to_bytes(4, "little")
+    open(path, "wb").write(bytes(data))
+    with native.RecordIOReader(path) as r:
+        got = list(r)
+    # chunk 1 intact; chunk 2 lost to the bad header; chunks 3-4 recovered
+    assert got[:8] == recs[:8]
+    assert set(recs[16:]) <= set(got)
+
+
+def test_prefetch_queue_empty_file_list():
+    """Empty file list + infinite epochs must terminate, not read OOB."""
+    with native.PrefetchQueue(capacity=4) as q:
+        q.start_files([], n_threads=2, n_epochs=-1)
+        assert list(q) == []

@@ -1,0 +1,214 @@
+"""Sparse-gradient (SelectedRows parity) tests.
+
+Reference: ``framework/selected_rows.h:32`` — embedding grads materialize as
+(rows, values); optimizer sparse kernels (``operators/optimizers/adam_op.h``
+SparseAdamFunctor, ``sgd_op.h`` SelectedRows branch, ``adagrad_op.h``)
+update only the touched rows. Here ``embedding(is_sparse=True)`` routes the
+autodiff through a per-lookup cotangent and the update ops take their
+scatter branch.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+
+
+VOCAB, DIM, BATCH = 50, 8, 12
+
+
+def _build(is_sparse, opt_factory, vocab=VOCAB, padding_idx=None,
+           regularization=None, global_clip=None):
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        label = fluid.layers.data("label", shape=[1], dtype="float32")
+        emb = fluid.layers.embedding(ids, size=[vocab, DIM],
+                                     is_sparse=is_sparse,
+                                     padding_idx=padding_idx)
+        pred = fluid.layers.fc(emb, size=1)
+        loss = fluid.layers.mean(fluid.layers.square(pred - label))
+        if global_clip is not None:
+            fluid.clip.set_gradient_clip(global_clip)
+        try:
+            opt_factory(regularization=regularization).minimize(loss)
+        finally:
+            if global_clip is not None:
+                fluid.clip.set_gradient_clip(None)
+    return main, startup, loss
+
+
+def _table_name(prog):
+    for p in prog.all_parameters():
+        if len(p.shape) == 2 and p.shape[0] == VOCAB:
+            return p.name
+    raise AssertionError("embedding table not found")
+
+
+def _run_steps(is_sparse, opt_factory, ids_batches, n_steps=1, **build_kw):
+    main, startup, loss = _build(is_sparse, opt_factory, **build_kw)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        for i in range(n_steps):
+            ids = ids_batches[i % len(ids_batches)]
+            label = rng.randn(len(ids), 1).astype("float32")
+            exe.run(main, feed={"ids": ids.reshape(-1, 1), "label": label},
+                    fetch_list=[loss])
+        table = scope.numpy(_table_name(main))
+    return table
+
+
+@pytest.mark.parametrize("opt", [
+    lambda **kw: fluid.optimizer.SGD(0.1, **kw),
+    lambda **kw: fluid.optimizer.Momentum(0.1, 0.9, **kw),
+    lambda **kw: fluid.optimizer.Adagrad(0.1, **kw),
+    lambda **kw: fluid.optimizer.Adam(0.1, **kw),
+])
+def test_dense_sparse_one_step_equivalence(opt):
+    ids = np.array([1, 4, 4, 7, 30, 30, 30, 2, 9, 9, 0, 49], dtype="int64")
+    dense = _run_steps(False, opt, [ids])
+    sparse = _run_steps(True, opt, [ids])
+    np.testing.assert_allclose(dense, sparse, rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_padding_idx_row_frozen():
+    """The padding row must receive zero gradient on the sparse path too
+    (the dense path masks it in the lookup's vjp)."""
+    ids = np.array([0, 0, 3, 3, 7, 0], dtype="int64")
+    sgd = lambda **kw: fluid.optimizer.SGD(0.5, **kw)
+    main, startup, loss = _build(True, sgd, padding_idx=0)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        name = _table_name(main)
+        before = scope.numpy(name).copy()
+        label = np.ones((len(ids), 1), dtype="float32")
+        exe.run(main, feed={"ids": ids.reshape(-1, 1), "label": label},
+                fetch_list=[loss])
+        after = scope.numpy(name)
+    np.testing.assert_array_equal(before[0], after[0])
+    assert np.abs(after[3] - before[3]).max() > 0
+
+
+def test_sparse_clip_and_decay_match_dense_on_touched_rows():
+    """Global-norm clipping and L2 decay participate in the sparse path:
+    sparse values count in the global norm exactly once per row and decay
+    applies row-wise, so touched rows match the dense run exactly.
+    Untouched rows stay frozen (lazy decay — the reference's SelectedRows
+    regularizer likewise only decays rows present in the gradient)."""
+    ids = np.array([1, 4, 4, 7, 30, 30, 30, 2, 9, 9, 0, 49], dtype="int64")
+    adam = lambda **kw: fluid.optimizer.Adam(0.1, **kw)
+    kw = dict(regularization=fluid.regularizer.L2Decay(0.05),
+              global_clip=fluid.clip.GradientClipByGlobalNorm(0.01))
+    dense = _run_steps(False, adam, [ids], **kw)
+    sparse = _run_steps(True, adam, [ids], **kw)
+    touched = sorted(set(ids.tolist()))
+    np.testing.assert_allclose(dense[touched], sparse[touched],
+                               rtol=2e-5, atol=2e-6)
+    untouched = [r for r in range(VOCAB) if r not in touched]
+    # dense decays every row; lazy sparse leaves untouched rows alone
+    assert np.abs(dense[untouched] - sparse[untouched]).max() > 1e-6
+
+
+def test_sparse_clip_only_matches_dense_exactly():
+    """With clipping but no decay, the whole table matches the dense run:
+    the sparse values' norm contribution equals the dense grad's norm."""
+    ids = np.array([1, 4, 4, 7, 30, 30, 30, 2, 9, 9, 0, 49], dtype="int64")
+    sgd = lambda **kw: fluid.optimizer.SGD(0.5, **kw)
+    kw = dict(global_clip=fluid.clip.GradientClipByGlobalNorm(0.01))
+    dense = _run_steps(False, sgd, [ids], **kw)
+    sparse = _run_steps(True, sgd, [ids], **kw)
+    np.testing.assert_allclose(dense, sparse, rtol=2e-5, atol=2e-6)
+
+
+def test_sparse_touches_only_fed_rows():
+    ids = np.array([3, 3, 5, 17], dtype="int64")
+    main, startup, loss = _build(True, lambda **kw: fluid.optimizer.SGD(0.5, **kw))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        name = _table_name(main)
+        before = scope.numpy(name).copy()
+        label = np.ones((len(ids), 1), dtype="float32")
+        exe.run(main, feed={"ids": ids.reshape(-1, 1), "label": label},
+                fetch_list=[loss])
+        after = scope.numpy(name)
+    touched = sorted(set(ids.tolist()))
+    untouched = [r for r in range(VOCAB) if r not in touched]
+    np.testing.assert_array_equal(before[untouched], after[untouched])
+    assert np.abs(after[touched] - before[touched]).max() > 0
+
+
+def test_sparse_adam_is_lazy():
+    """Rows touched in step 1 but not step 2 keep their step-1 value under
+    sparse adam (ref lazy_mode), while dense adam keeps moving them on the
+    stale momentum."""
+    step1 = np.array([5] * BATCH, dtype="int64")
+    step2 = np.array([9] * BATCH, dtype="int64")
+    opt = lambda **kw: fluid.optimizer.Adam(0.1, **kw)
+    dense = _run_steps(False, opt, [step1, step2], n_steps=2)
+    sparse = _run_steps(True, opt, [step1, step2], n_steps=2)
+    # row 5: dense moved it twice (momentum), sparse only once
+    assert np.abs(dense[5] - sparse[5]).max() > 1e-6
+    # row 0: never touched, identical under both
+    np.testing.assert_allclose(dense[0], sparse[0], rtol=1e-6)
+
+
+def test_weight_tied_table_falls_back_to_dense():
+    """A sparse-marked table that is ALSO consumed densely (weight tying)
+    must get a dense grad covering both uses."""
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        ids = fluid.layers.data("ids", shape=[1], dtype="int64")
+        emb = fluid.layers.embedding(ids, size=[VOCAB, DIM], is_sparse=True)
+        table = main.all_parameters()[0]
+        tv = main.global_block().var(table.name)
+        # dense second use: project onto the table (weight tying)
+        logits = fluid.layers.matmul(emb, tv, transpose_y=True)
+        loss = fluid.layers.mean(logits)
+        pg = fluid.optimizer.SGD(0.1).minimize(loss)[1]
+    (p, g), = [x for x in pg if x[0].name == table.name]
+    assert getattr(g, "sparse_rows_var", None) is None
+
+
+def test_sparse_on_mesh_matches_single_device():
+    """Sparse update of an mp-sharded table over the 8-device mesh equals
+    the single-device result (shard-local scatter under GSPMD)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 4:
+        pytest.skip("needs multi-device mesh")
+    ids = np.array([1, 4, 4, 7, 30, 30, 30, 2, 9, 9, 0, 49], dtype="int64")
+
+    def factory(**kw):
+        return fluid.optimizer.Adam(0.1, **kw)
+
+    single = _run_steps(True, factory, [ids], n_steps=2)
+
+    main, startup, loss = _build(True, factory)
+    table = _table_name(main)
+    # row-shard the table over 'mp' like the distributed lookup-table mode
+    main.global_block().var(table).sharding = ("mp", None)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "mp"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(3)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        compiled = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=loss.name, mesh=mesh, dp_axis="dp")
+        for _ in range(2):
+            label = rng.randn(len(ids), 1).astype("float32")
+            exe.run(compiled,
+                    feed={"ids": ids.reshape(-1, 1), "label": label},
+                    fetch_list=[loss])
+        sharded = scope.numpy(table)
+    np.testing.assert_allclose(single, sharded, rtol=2e-5, atol=2e-6)
