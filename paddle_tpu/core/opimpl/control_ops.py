@@ -169,21 +169,27 @@ def _while_block(env, op):
     cond_name = op.attr("cond_name")
     carry_vars = op.input_list("Carry")
     carry_names = [v.name for v in carry_vars]
+    # tensor-array fill levels ride along as hidden carries so
+    # array_length stays correct across iterations
+    aux_names = [n + "@LEN" for n in carry_names if n + "@LEN" in env]
+    all_names = [cond_name] + carry_names + aux_names
 
     def cond_fn(carry):
         return carry[0].reshape(()).astype(bool)
 
     def body_fn(carry):
         local = dict(env)
-        local.update({n: c for n, c in zip([cond_name] + carry_names, carry)})
+        local.update({n: c for n, c in zip(all_names, carry)})
         for o in body_ops:
             run_op(local, o)
-        return tuple([local[cond_name]] + [local[n] for n in carry_names])
+        return tuple(local[n] for n in all_names)
 
-    init = tuple([env[cond_name]] + [env[n] for n in carry_names])
+    init = tuple(env[n] for n in all_names)
     final = jax.lax.while_loop(cond_fn, body_fn, init)
-    for v, val in zip(op.output_list("Out"), final[1:]):
+    for v, val in zip(op.output_list("Out"), final[1:1 + len(carry_names)]):
         put(env, v, val)
+    for n, val in zip(aux_names, final[1 + len(carry_names):]):
+        env[n] = val
 
 
 @register("scan_block")

@@ -16,7 +16,10 @@ from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
-from .control_flow import StaticRNN, While, Switch, cond  # noqa: F401
+from .control_flow import (StaticRNN, While, Switch, cond,  # noqa: F401
+                           array_write, array_read, create_array,
+                           array_length, IfElse, less_than, equal,
+                           increment)
 from .learning_rate_scheduler import (  # noqa: F401
     exponential_decay, natural_exp_decay, inverse_time_decay,
     polynomial_decay, piecewise_decay, cosine_decay, noam_decay,

@@ -12,7 +12,8 @@ from ..core.framework import Variable
 from ..core.layer_helper import LayerHelper
 
 __all__ = ["StaticRNN", "While", "Switch", "cond", "increment",
-           "less_than", "equal", "array_write", "array_read"]
+           "less_than", "equal", "array_write", "array_read",
+           "create_array", "array_length", "IfElse"]
 
 
 def less_than(x, y, force_cpu=None, cond=None):
@@ -263,6 +264,18 @@ class _SwitchCase:
         return False
 
 
+def _append_cond_block(pred, true_ops, t_outs, false_ops, f_outs):
+    """Shared cond_block lowering used by ``cond`` and ``IfElse``."""
+    gb = framework.default_main_program().global_block()
+    outs = [gb.create_var(shape=v.shape, dtype=str(v.dtype)) for v in t_outs]
+    gb.append_op(
+        "cond_block", {"Cond": pred}, {"Out": outs},
+        {"true_ops": list(true_ops), "false_ops": list(false_ops),
+         "true_out_names": [v.name for v in t_outs],
+         "false_out_names": [v.name for v in f_outs]})
+    return outs
+
+
 def cond(pred, true_fn, false_fn, name=None):
     """Functional conditional (modern jax-style; the reference's
     ``ConditionalBlock`` pattern is subsumed): both branches are traced
@@ -274,24 +287,100 @@ def cond(pred, true_fn, false_fn, name=None):
     fb = prog._create_block()
     false_out = false_fn()
     prog._rollback()
-    gb = prog.global_block()
     t_outs = true_out if isinstance(true_out, (list, tuple)) else [true_out]
     f_outs = false_out if isinstance(false_out, (list, tuple)) else [false_out]
-    outs = [gb.create_var(shape=v.shape, dtype=str(v.dtype)) for v in t_outs]
-    # record branch output names so the impl can fetch them
-    gb.append_op(
-        "cond_block", {"Cond": pred}, {"Out": outs},
-        {"true_ops": list(tb.ops), "false_ops": list(fb.ops),
-         "true_out_names": [v.name for v in t_outs],
-         "false_out_names": [v.name for v in f_outs]})
+    outs = _append_cond_block(pred, tb.ops, t_outs, fb.ops, f_outs)
     return outs[0] if len(outs) == 1 else outs
 
 
-def array_write(x, i, array=None):
-    raise NotImplementedError(
-        "tensor_array ops land with beam-search in a later round")
+def create_array(dtype, capacity=None):
+    """TensorArray (ref ``layers/control_flow.py`` create_array /
+    ``lod_tensor_array.h``). TPU-native arrays are fixed-capacity stacked
+    buffers [capacity, ...] — static shapes for XLA; the buffer materializes
+    (zero-filled) on the first ``array_write``."""
+    gb = framework.default_main_program().current_block()
+    arr = gb.create_var(shape=None, dtype=dtype)
+    arr._tensor_array_capacity = capacity
+    return arr
+
+
+def array_write(x, i, array=None, capacity=None):
+    """Write ``x`` at position ``i`` (ref tensor_array_write). Returns the
+    array; inside a While body the write updates the loop carry in place,
+    so list the array in ``loop_vars``."""
+    if array is None:
+        array = create_array(str(x.dtype), capacity)
+    cap = capacity or getattr(array, "_tensor_array_capacity", None)
+    if cap is None:
+        raise ValueError(
+            "array_write needs a static capacity: pass capacity= here or "
+            "on create_array (TPU arrays are fixed-capacity buffers)")
+    array._tensor_array_capacity = cap
+    cb = framework.default_main_program().current_block()
+    cb.append_op("array_write", {"X": x, "I": i}, {"Out": array},
+                 {"capacity": int(cap)})
+    return array
 
 
 def array_read(array, i):
-    raise NotImplementedError(
-        "tensor_array ops land with beam-search in a later round")
+    cb = framework.default_main_program().current_block()
+    out = cb.create_var(shape=None, dtype=str(array.dtype))
+    cb.append_op("array_read", {"Array": array, "I": i}, {"Out": out}, {})
+    return out
+
+
+def array_length(array):
+    cb = framework.default_main_program().current_block()
+    out = cb.create_var(shape=(), dtype="int64")
+    cb.append_op("array_length", {"Array": array}, {"Out": out}, {})
+    return out
+
+
+class IfElse:
+    """Ref ``layers/control_flow.py`` IfElse: two-branch construct over a
+    boolean condition. Thin sugar over ``cond`` — both branches trace to
+    lax.cond; ``input(x)`` returns x unchanged (no LoD split on TPU; the
+    predicate is a scalar)."""
+
+    def __init__(self, cond_var, name=None):
+        self._cond = cond_var
+        self._branches = {True: None, False: None}
+        self._outputs = {True: None, False: None}
+        self._in_true = None
+
+    class _Branch:
+        def __init__(self, owner, is_true):
+            self.owner = owner
+            self.is_true = is_true
+
+        def __enter__(self):
+            self.owner._in_true = self.is_true
+            prog = framework.default_main_program()
+            self.block = prog._create_block()
+            self.owner._branches[self.is_true] = self.block
+            return self.block
+
+        def __exit__(self, *a):
+            framework.default_main_program()._rollback()
+            self.owner._in_true = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        self._outputs[self._in_true] = list(outs)
+
+    def __call__(self):
+        t_outs = self._outputs[True]
+        f_outs = self._outputs[False]
+        assert t_outs and f_outs and len(t_outs) == len(f_outs), \
+            "both branches must call output() with the same arity"
+        return _append_cond_block(self._cond, self._branches[True].ops,
+                                  t_outs, self._branches[False].ops, f_outs)

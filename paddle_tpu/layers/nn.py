@@ -37,6 +37,7 @@ __all__ = [
     "split", "warpctc", "nce", "hsigmoid", "cumsum",
     "dynamic_lstm", "dynamic_gru", "lstm", "gru_unit",
     "moe_ffn",
+    "beam_search", "beam_search_gather", "beam_search_decode",
 ]
 
 
@@ -867,6 +868,67 @@ def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
     raise NotImplementedError("hsigmoid lands with the word2vec batch")
+
+
+# ---------------------------------------------------------------------------
+# beam-search decode (ref ``nn.py`` beam_search / beam_search_decode over
+# ``operators/beam_search_op.cc``; TPU-native dense [B, K] re-design — see
+# ``core/opimpl/decode_ops.py``)
+# ---------------------------------------------------------------------------
+
+def beam_search(pre_ids, pre_scores, scores, beam_size, end_id,
+                return_parent_idx=True, name=None):
+    """One pruning step: ``pre_ids``/``pre_scores`` [B, K], ``scores``
+    [B, K, V] next-token log-probs. Returns (selected_ids, selected_scores,
+    parent_idx), each [B, K]. Step 0 convention: initialize pre_scores to
+    [0, -1e9, ...] so the duplicated start beams collapse to one."""
+    helper = LayerHelper("beam_search", name=name)
+    b, k = tuple(pre_ids.shape)[:2]
+    sel_ids = helper.create_variable_for_type_inference(
+        dtype=str(pre_ids.dtype), shape=(b, k))
+    sel_scores = helper.create_variable_for_type_inference(
+        dtype=str(pre_scores.dtype), shape=(b, k))
+    parent = helper.create_variable_for_type_inference(
+        dtype="int32", shape=(b, k))
+    helper.append_op(
+        "beam_search_step",
+        {"PreIds": pre_ids, "PreScores": pre_scores, "Scores": scores},
+        {"SelectedIds": sel_ids, "SelectedScores": sel_scores,
+         "ParentIdx": parent},
+        {"beam_size": beam_size, "end_id": end_id})
+    if return_parent_idx:
+        return sel_ids, sel_scores, parent
+    return sel_ids, sel_scores
+
+
+def beam_search_gather(x, parent_idx, name=None):
+    """Reorder per-beam state ``x`` [B, K, ...] by ``parent_idx`` [B, K]
+    (the reference reorders hidden state via LoD; here an explicit gather)."""
+    helper = LayerHelper("beam_search_gather", name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(x), shape=x.shape)
+    helper.append_op("beam_search_gather", {"X": x, "Ids": parent_idx},
+                     {"Out": out}, {})
+    return out
+
+
+def beam_search_decode(ids_array, parents_array, length, final_scores,
+                       beam_size, end_id, name=None):
+    """Backtrack per-step (ids, parents) arrays — written by ``array_write``
+    inside the decode loop — into sentences [B, K, T] + scores [B, K]
+    (ref ``beam_search_decode_op.cc``)."""
+    helper = LayerHelper("beam_search_decode", name=name)
+    sent = helper.create_variable_for_type_inference(dtype="int64",
+                                                     shape=None)
+    sscores = helper.create_variable_for_type_inference(
+        dtype=str(final_scores.dtype), shape=final_scores.shape)
+    helper.append_op(
+        "beam_search_decode",
+        {"IdsArray": ids_array, "ParentsArray": parents_array,
+         "Length": length, "FinalScores": final_scores},
+        {"SentenceIds": sent, "SentenceScores": sscores},
+        {"beam_size": beam_size, "end_id": end_id})
+    return sent, sscores
 
 
 # ---------------------------------------------------------------------------

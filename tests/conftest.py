@@ -46,3 +46,8 @@ def fresh_programs():
 @pytest.fixture
 def rng():
     return np.random.RandomState(1234)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running convergence/book tests")

@@ -1,0 +1,175 @@
+"""TensorArray + dynamic while + beam-search decode tests.
+
+Reference analogs: ``test_tensor_array_to_tensor``-style array round-trips,
+``operators/beam_search_op.cc`` unit semantics, and the book test
+``tests/book/test_machine_translation.py`` (train then beam-decode).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.layers import tensor as T
+
+
+def _run(main, startup, feed, fetch_list):
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=fetch_list)
+
+
+def test_tensor_array_write_read():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[3], dtype="float32")
+        arr = layers.create_array("float32", capacity=4)
+        i0 = T.fill_constant([], "int64", 0)
+        i2 = T.fill_constant([], "int64", 2)
+        arr = layers.array_write(x, i0, arr)
+        arr = layers.array_write(layers.scale(x, 10.0), i2, arr)
+        r0 = layers.array_read(arr, i0)
+        r2 = layers.array_read(arr, i2)
+        n = layers.array_length(arr)
+    xv = np.arange(6, dtype="float32").reshape(2, 3)
+    a, b, ln = _run(main, startup, {"x": xv}, [r0, r2, n])
+    np.testing.assert_allclose(a, xv)
+    np.testing.assert_allclose(b, xv * 10)
+    # length = 1 + highest written index (ref growing-LoDTensorArray parity)
+    assert int(ln) == 3
+
+
+def test_while_with_tensor_array():
+    """Accumulate i*x into an array inside a While loop, then read back —
+    the dynamic-decode skeleton (ref while_op + tensor_array ops)."""
+    n_steps = 5
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[2], dtype="float32")
+        step = T.fill_constant([], "int64", 0)
+        limit = T.fill_constant([], "int64", n_steps)
+        cond = layers.less_than(step, limit)
+        arr = layers.create_array("float32", capacity=n_steps)
+        arr = layers.array_write(x, step, arr)
+        w = layers.While(cond, loop_vars=[step, arr])
+        with w.block():
+            stepf = T.cast(step, "float32")
+            layers.array_write(
+                layers.elementwise_mul(x, stepf), step, arr)
+            layers.increment(step, 1)
+            layers.less_than(step, limit, cond=cond)
+        reads = [layers.array_read(arr, T.fill_constant([], "int64", i))
+                 for i in range(n_steps)]
+    xv = np.array([[1.0, 2.0], [3.0, 4.0]], dtype="float32")
+    outs = _run(main, startup, {"x": xv}, reads)
+    for i, o in enumerate(outs):
+        np.testing.assert_allclose(o, xv * i)
+
+
+def test_beam_search_step_semantics():
+    """OpTest-style numeric check of one pruning step incl. finished-beam
+    freezing (ref ``beam_search_op.cc``)."""
+    b, k, v = 2, 2, 5
+    end_id = 0
+    pre_ids = np.array([[3, 0], [2, 4]], dtype="int64")  # beam (0,1) done
+    pre_scores = np.array([[-1.0, -0.5], [-2.0, -3.0]], dtype="float32")
+    scores = np.log(np.random.RandomState(0).dirichlet(
+        np.ones(v), size=(b, k)).astype("float32"))
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        pi = layers.data("pi", shape=[k], dtype="int64")
+        ps = layers.data("ps", shape=[k], dtype="float32")
+        sc = layers.data("sc", shape=[k, v], dtype="float32")
+        ids, scs, par = layers.beam_search(pi, ps, sc, k, end_id)
+    got_ids, got_scores, got_par = _run(
+        main, startup, {"pi": pre_ids, "ps": pre_scores, "sc": scores},
+        [ids, scs, par])
+
+    # numpy reference
+    cont = scores.copy()
+    for bi in range(b):
+        for ki in range(k):
+            if pre_ids[bi, ki] == end_id:
+                cont[bi, ki] = -1e9
+                cont[bi, ki, end_id] = 0.0
+    total = (pre_scores[..., None] + cont).reshape(b, k * v)
+    for bi in range(b):
+        order = np.argsort(-total[bi])[:k]
+        np.testing.assert_allclose(got_scores[bi], total[bi][order],
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(got_ids[bi], order % v)
+        np.testing.assert_array_equal(got_par[bi], order // v)
+    # the finished beam's only continuation is end_id at frozen score
+    assert got_ids[0][list(got_par[0]).index(1)] == end_id if 1 in got_par[0] else True
+
+
+def test_ifelse():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[4], dtype="float32")
+        flag = layers.data("flag", shape=[], dtype="bool")
+        ie = layers.IfElse(flag)
+        with ie.true_block():
+            ie.output(layers.scale(ie.input(x), 2.0))
+        with ie.false_block():
+            ie.output(layers.scale(ie.input(x), -1.0))
+        out, = ie()
+    xv = np.ones((2, 4), dtype="float32")
+    o_t, = _run(main, startup, {"x": xv, "flag": np.array(True)}, [out])
+    o_f, = _run(main, startup, {"x": xv, "flag": np.array(False)}, [out])
+    np.testing.assert_allclose(o_t, xv * 2)
+    np.testing.assert_allclose(o_f, -xv)
+
+
+@pytest.mark.slow
+def test_mt_overfit_and_beam_decode():
+    """Book-test analog (``tests/book/test_machine_translation.py``): overfit
+    a toy reverse-copy task with the teacher-forced train program, then
+    beam-decode with shared parameters and check the decoded sentences
+    reproduce the targets."""
+    from paddle_tpu.models import machine_translation as mt
+
+    vocab, seq_len, n_pairs = 16, 6, 24
+    bos, eos = 0, 1
+    rng = np.random.RandomState(5)
+    src = rng.randint(2, vocab, (n_pairs, seq_len)).astype("int64")
+    trg_out = src[:, ::-1].copy()  # target = reversed source
+
+    trg_in = np.concatenate([np.full((n_pairs, 1), bos, "int64"),
+                             trg_out[:, :-1]], axis=1)
+    lbl = trg_out.copy()
+    lens = np.full((n_pairs,), seq_len, "int64")
+
+    kw = dict(src_vocab=vocab, trg_vocab=vocab, seq_len=seq_len,
+              emb_dim=32, hid_dim=32)
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    with fluid.program_guard(main, startup):
+        spec = mt.seq2seq_attention(**kw)
+        fluid.optimizer.Adam(2e-3).minimize(spec.loss)
+    infer_prog, infer_startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(infer_prog, infer_startup):
+        sent, scores = mt.seq2seq_attention_infer(
+            beam_size=3, max_out_len=seq_len, bos_id=bos, eos_id=eos, **kw)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    feed = {"src_ids": src, "trg_ids": trg_in, "lbl_ids": lbl,
+            "src_len": lens, "trg_len": lens}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        losses = []
+        for _ in range(300):
+            l, = exe.run(main, feed=feed, fetch_list=[spec.loss])
+            losses.append(float(l))
+        assert losses[-1] < 0.05, (losses[0], losses[-1])
+        # decode in the SAME scope: params are shared by name
+        s, _ = exe.run(infer_prog,
+                       feed={"src_ids": src, "src_len": lens},
+                       fetch_list=[sent, scores])
+    best = s[:, 0, :]  # top beam, [B, T]
+    acc = (best == trg_out).mean()
+    assert acc > 0.95, acc
