@@ -35,6 +35,7 @@ __all__ = [
     "multi_head_attention", "scaled_dot_product_attention",
     "row_conv", "autoincreased_step_counter", "cos_sim",
     "split", "warpctc", "nce", "hsigmoid", "cumsum",
+    "linear_chain_crf", "crf_decoding",
     "dynamic_lstm", "dynamic_gru", "lstm", "gru_unit",
     "moe_ffn",
     "beam_search", "beam_search_gather", "beam_search_decode",
@@ -850,24 +851,151 @@ def mse_loss(input, label, name=None):
 
 def warpctc(input, label, blank=0, norm_by_times=False, input_length=None,
             label_length=None, name=None):
-    """CTC loss (ref ``warpctc_op``): padded [B, T, C] logits + lengths; the
-    impl composes from log-softmax + the standard alpha recursion in lax.scan
-    (see opimpl/sequence extras in later rounds). Currently requires
-    input_length/label_length (no LoD)."""
-    raise NotImplementedError(
-        "warpctc lands with the sequence-labeling batch in a later round")
+    """CTC loss (ref ``warpctc_op.cc``): padded ``[B, T, C]`` logits
+    (softmax applied internally, warp-ctc convention), ``label`` [B, L],
+    per-example ``input_length``/``label_length`` [B] (defaulting to the
+    padded sizes). Returns [B, 1] negative log likelihood. The alpha
+    recursion runs as a lax.scan in log space — no external warp-ctc lib,
+    gradient via autodiff through the scan."""
+    helper = LayerHelper("warpctc", name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(input), shape=(input.shape[0], 1))
+    inputs = {"Logits": input, "Label": label}
+    if input_length is not None:
+        inputs["LogitsLength"] = input_length
+    if label_length is not None:
+        inputs["LabelLength"] = label_length
+    helper.append_op("warpctc", inputs, {"Loss": out},
+                     {"blank": blank, "norm_by_times": norm_by_times})
+    return out
+
+
+def linear_chain_crf(input, label, param_attr=None, length=None, name=None):
+    """Linear-chain CRF negative log likelihood (ref
+    ``linear_chain_crf_op.cc``): ``input`` [B, T, D] emissions, ``label``
+    [B, T]; creates the [D+2, D] transition parameter (row 0 start, row 1
+    end, rows 2.. pairwise). Returns [B, 1] cost to minimize."""
+    helper = LayerHelper("linear_chain_crf", param_attr=param_attr,
+                         name=name)
+    size = input.shape[-1]
+    transition = helper.create_parameter(
+        helper.param_attr, shape=[size + 2, size], dtype=_dtype(input))
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(input), shape=(input.shape[0], 1))
+    inputs = {"Emission": input, "Transition": transition, "Label": label}
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("linear_chain_crf", inputs,
+                     {"LogLikelihood": out}, {})
+    return out
+
+
+def crf_decoding(input, param_attr, label=None, length=None, name=None):
+    """Viterbi decode with the CRF's transition parameter (ref
+    ``crf_decoding_op.cc``); pass the same ``param_attr`` name used by
+    ``linear_chain_crf``. With ``label`` given, returns the per-position
+    correctness mask instead of the path (reference semantics)."""
+    helper = LayerHelper("crf_decoding", param_attr=param_attr, name=name)
+    size = input.shape[-1]
+    from ..core import framework as _fw
+    attr = ParamAttr._to_attr(param_attr)
+    gb = _fw.default_main_program().global_block()
+    if attr.name and gb.has_var(attr.name):
+        # reuse the trained transition var in-program (no duplicate init)
+        transition = gb.var(attr.name)
+    else:
+        # separate infer program: create under the shared name; values come
+        # from the scope at run time
+        transition = helper.create_parameter(
+            helper.param_attr, shape=[size + 2, size], dtype=_dtype(input))
+    out = helper.create_variable_for_type_inference(
+        dtype="int64", shape=tuple(input.shape[:2]))
+    inputs = {"Emission": input, "Transition": transition}
+    if label is not None:
+        inputs["Label"] = label
+    if length is not None:
+        inputs["Length"] = length
+    helper.append_op("crf_decoding", inputs, {"ViterbiPath": out}, {})
+    return out
 
 
 def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
         bias_attr=None, num_neg_samples=None, name=None, sampler="uniform",
         custom_dist=None, seed=0, is_sparse=False):
-    raise NotImplementedError("nce lands with the word2vec batch")
+    """Noise-contrastive estimation loss (ref ``nce_op.cc``): ``input``
+    [B, D], ``label`` [B, 1]; samples ``num_neg_samples`` noise classes per
+    example (uniform or log_uniform). ``seed`` != 0 fixes the sample draw
+    (reference parity); 0 threads the executor PRNG."""
+    if custom_dist is not None or sample_weight is not None:
+        raise NotImplementedError(
+            "nce custom_dist/sample_weight are not supported; use "
+            "sampler='uniform' or 'log_uniform'")
+    if sampler not in ("uniform", "log_uniform"):
+        raise ValueError("unsupported nce sampler %r" % (sampler,))
+    helper = LayerHelper("nce", param_attr=param_attr, bias_attr=bias_attr,
+                         name=name)
+    dim = input.shape[-1]
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[num_total_classes, dim],
+                                dtype=_dtype(input))
+    b = helper.create_parameter(helper.bias_attr,
+                                shape=[num_total_classes],
+                                dtype=_dtype(input), is_bias=True)
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(input), shape=(input.shape[0], 1))
+    helper.append_op(
+        "nce", {"Input": input, "Label": label, "Weight": w, "Bias": b},
+        {"Cost": out},
+        {"num_neg_samples": num_neg_samples or 10, "sampler": sampler,
+         "seed": seed})
+    return out
+
+
+def _hsigmoid_simple_code_tables(num_classes):
+    """Default complete-binary-tree paths (ref ``math/matrix_bit_code.h``
+    SimpleCode): class c maps to code c + num_classes; node index at bit i
+    is (code >> (i+1)) - 1, bit value (code >> i) & 1."""
+    rows = []
+    for c in range(num_classes):
+        code = c + num_classes
+        length = code.bit_length() - 1
+        rows.append(([(code >> (i + 1)) - 1 for i in range(length)],
+                     [(code >> i) & 1 for i in range(length)]))
+    max_len = max(len(r[0]) for r in rows)
+    table = [r[0] + [-1] * (max_len - len(r[0])) for r in rows]
+    codes = [[float(v) for v in r[1]] + [0.0] * (max_len - len(r[1]))
+             for r in rows]
+    return table, codes
 
 
 def hsigmoid(input, label, num_classes, param_attr=None, bias_attr=None,
              name=None, path_table=None, path_code=None, is_custom=False,
              is_sparse=False):
-    raise NotImplementedError("hsigmoid lands with the word2vec batch")
+    """Hierarchical sigmoid (ref ``hierarchical_sigmoid_op.cc``): log-time
+    softmax over a class tree. Default: complete binary tree with
+    ``num_classes - 1`` internal nodes; custom: ``path_table``/``path_code``
+    vars [B, L] (pad with -1)."""
+    helper = LayerHelper("hsigmoid", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    dim = input.shape[-1]
+    n_nodes = num_classes if is_custom else num_classes - 1
+    w = helper.create_parameter(helper.param_attr, shape=[n_nodes, dim],
+                                dtype=_dtype(input))
+    b = helper.create_parameter(helper.bias_attr, shape=[n_nodes],
+                                dtype=_dtype(input), is_bias=True)
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(input), shape=(input.shape[0], 1))
+    inputs = {"Input": input, "Label": label, "W": w, "Bias": b}
+    attrs = {"num_classes": num_classes}
+    if is_custom:
+        inputs["PathTable"] = path_table
+        inputs["PathCode"] = path_code
+    else:
+        table, codes = _hsigmoid_simple_code_tables(num_classes)
+        attrs["path_table"] = table
+        attrs["path_code"] = codes
+    helper.append_op("hsigmoid", inputs, {"Cost": out}, attrs)
+    return out
 
 
 # ---------------------------------------------------------------------------

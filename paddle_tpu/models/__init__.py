@@ -18,4 +18,5 @@ from . import transformer  # noqa: F401
 from . import bert  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import word2vec  # noqa: F401
+from . import ocr_ctc  # noqa: F401
 from . import machine_translation  # noqa: F401

@@ -1,0 +1,335 @@
+"""CTC / CRF / NCE / hsigmoid tests.
+
+References checked against INDEPENDENT oracles: CTC and CRF against
+brute-force enumeration over all paths (tiny sizes), hsigmoid against the
+tree-probability sum-to-one identity, all with OpTest-style numeric
+gradient checks (ref ``tests/unittests/test_warpctc_op.py``,
+``test_linear_chain_crf_op.py``, ``test_nce.py``, ``test_hsigmoid_op.py``).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.framework import default_main_program
+
+import op_test
+
+
+def _softmax(x, axis=-1):
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def _ctc_brute(logits, label, blank):
+    """P(label) by enumerating every alignment path (oracle)."""
+    t, c = logits.shape
+    probs = _softmax(logits)
+    total = 0.0
+    for path in itertools.product(range(c), repeat=t):
+        # collapse: remove repeats, then blanks
+        collapsed = []
+        prev = None
+        for s in path:
+            if s != prev:
+                collapsed.append(s)
+            prev = s
+        collapsed = [s for s in collapsed if s != blank]
+        if collapsed == list(label):
+            p = 1.0
+            for ti, s in enumerate(path):
+                p *= probs[ti, s]
+            total += p
+    return total
+
+
+def test_warpctc_vs_bruteforce():
+    rng = np.random.RandomState(0)
+    t, c = 4, 3
+    blank = 0
+    logits = rng.randn(2, t, c).astype("float32")
+    label = np.array([[1, 2], [2, 2]], dtype="int64")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        lg = layers.data("lg", shape=[t, c], dtype="float32")
+        lb = layers.data("lb", shape=[2], dtype="int64")
+        loss = layers.warpctc(lg, lb, blank=blank)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        got, = exe.run(main, feed={"lg": logits, "lb": label},
+                       fetch_list=[loss])
+    for b in range(2):
+        want = -np.log(_ctc_brute(logits[b], label[b], blank))
+        np.testing.assert_allclose(got[b, 0], want, rtol=1e-4)
+
+
+def test_warpctc_variable_lengths():
+    """Per-example lengths: padded region must not change the loss."""
+    rng = np.random.RandomState(1)
+    t, c = 5, 4
+    logits = rng.randn(1, t, c).astype("float32")
+    label = np.array([[2, 1, 0]], dtype="int64")  # only first 2 real
+
+    def run(lg, lb, tl, ll):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            lgv = layers.data("lg", shape=list(lg.shape[1:]),
+                              dtype="float32")
+            lbv = layers.data("lb", shape=[lb.shape[1]], dtype="int64")
+            tlv = layers.data("tl", shape=[], dtype="int64")
+            llv = layers.data("ll", shape=[], dtype="int64")
+            loss = layers.warpctc(lgv, lbv, blank=3, input_length=tlv,
+                                  label_length=llv)
+        exe = fluid.Executor(fluid.CPUPlace())
+        with fluid.scope_guard(fluid.Scope()):
+            exe.run(startup)
+            out, = exe.run(main, feed={"lg": lg, "lb": lb, "tl": tl,
+                                       "ll": ll}, fetch_list=[loss])
+        return out[0, 0]
+
+    a = run(logits, label, np.array([4], "int64"), np.array([2], "int64"))
+    # same computation with the padding stripped
+    b = run(logits[:, :4], label[:, :2], np.array([4], "int64"),
+            np.array([2], "int64"))
+    np.testing.assert_allclose(a, b, rtol=1e-5)
+    want = -np.log(_ctc_brute(logits[0, :4], [2, 1], 3))
+    np.testing.assert_allclose(a, want, rtol=1e-4)
+
+
+def test_warpctc_grad():
+    rng = np.random.RandomState(2)
+    t, c = 4, 3
+    logits = rng.randn(2, t, c).astype("float32")
+    label = np.array([[1, 2], [2, 1]], dtype="int64")
+
+    def build():
+        lg = layers.data("lg", shape=[t, c], dtype="float32")
+        lb = layers.data("lb", shape=[2], dtype="int64")
+        return layers.reduce_sum(layers.warpctc(lg, lb, blank=0))
+
+    op_test.check_grad(build, {"lg": logits, "lb": label}, ["lg"])
+
+
+def _crf_score(emission, transition, path):
+    start, end, w = transition[0], transition[1], transition[2:]
+    s = start[path[0]] + end[path[-1]] + emission[0, path[0]]
+    for t in range(1, len(path)):
+        s += w[path[t - 1], path[t]] + emission[t, path[t]]
+    return s
+
+
+def test_linear_chain_crf_vs_bruteforce():
+    rng = np.random.RandomState(3)
+    t, d = 4, 3
+    emission = rng.randn(2, t, d).astype("float32")
+    transition = rng.randn(d + 2, d).astype("float32")
+    label = np.array([[0, 1, 2, 1], [2, 0, 0, 1]], dtype="int64")
+
+    feed = {"em": emission, "tr": transition, "lb": label}
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = default_main_program().global_block()
+        em = layers.data("em", shape=[t, d], dtype="float32")
+        tr = gb.create_var(name="tr", shape=transition.shape,
+                           dtype="float32", is_data=True)
+        lb = layers.data("lb", shape=[t], dtype="int64")
+        out = gb.create_var(name="nll", shape=(2, 1), dtype="float32")
+        gb.append_op("linear_chain_crf",
+                     {"Emission": em, "Transition": tr, "Label": lb},
+                     {"LogLikelihood": out}, {})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed=feed, fetch_list=[out])
+
+    for b in range(2):
+        scores = [_crf_score(emission[b], transition, p)
+                  for p in itertools.product(range(d), repeat=t)]
+        log_z = np.log(np.sum(np.exp(np.array(scores))))
+        want = log_z - _crf_score(emission[b], transition, label[b])
+        np.testing.assert_allclose(got[b, 0], want, rtol=1e-4)
+
+
+def test_linear_chain_crf_grad():
+    rng = np.random.RandomState(4)
+    t, d = 3, 3
+    emission = rng.randn(2, t, d).astype("float32")
+    transition = (rng.randn(d + 2, d) * 0.3).astype("float32")
+    label = np.array([[0, 1, 2], [2, 0, 1]], dtype="int64")
+
+    def build():
+        gb = default_main_program().global_block()
+        em = layers.data("em", shape=[t, d], dtype="float32")
+        tr = gb.create_var(name="tr", shape=transition.shape,
+                           dtype="float32", is_data=True)
+        lb = layers.data("lb", shape=[t], dtype="int64")
+        out = gb.create_var(name="nll", shape=(2, 1), dtype="float32")
+        gb.append_op("linear_chain_crf",
+                     {"Emission": em, "Transition": tr, "Label": lb},
+                     {"LogLikelihood": out}, {})
+        return layers.reduce_sum(out)
+
+    op_test.check_grad(
+        build, {"em": emission, "tr": transition, "lb": label},
+        ["em", "tr"])
+
+
+def test_crf_decoding_vs_bruteforce():
+    rng = np.random.RandomState(5)
+    t, d = 4, 3
+    emission = rng.randn(2, t, d).astype("float32")
+    transition = rng.randn(d + 2, d).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        gb = default_main_program().global_block()
+        em = layers.data("em", shape=[t, d], dtype="float32")
+        tr = gb.create_var(name="tr", shape=transition.shape,
+                           dtype="float32", is_data=True)
+        out = gb.create_var(name="path", shape=(2, t), dtype="int64")
+        gb.append_op("crf_decoding", {"Emission": em, "Transition": tr},
+                     {"ViterbiPath": out}, {})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={"em": emission, "tr": transition},
+                       fetch_list=[out])
+    for b in range(2):
+        best = max(itertools.product(range(d), repeat=t),
+                   key=lambda p: _crf_score(emission[b], transition, p))
+        np.testing.assert_array_equal(got[b], np.array(best))
+
+
+def test_crf_train_decode_e2e():
+    """Train a CRF tagger on a deterministic toy tagging rule and check
+    Viterbi recovers the rule (book-test analog: label_semantic_roles)."""
+    rng = np.random.RandomState(6)
+    b, t, nfeat, d = 32, 6, 8, 4
+    xs = rng.randint(0, nfeat, (b, t)).astype("int64")
+    ys = (xs % d).astype("int64")  # tag = feature mod d
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[t], dtype="int64")
+        y = layers.data("y", shape=[t], dtype="int64")
+        emb = layers.embedding(x, size=[nfeat, 16])
+        emission = layers.fc(emb, size=d, num_flatten_dims=2)
+        crf_cost = layers.linear_chain_crf(
+            emission, y, param_attr=fluid.ParamAttr(name="crfw"))
+        loss = layers.mean(crf_cost)
+        fluid.optimizer.Adam(0.05).minimize(loss)
+        path = layers.crf_decoding(emission,
+                                   param_attr=fluid.ParamAttr(name="crfw"))
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        for _ in range(60):
+            lv, = exe.run(main, feed={"x": xs, "y": ys},
+                          fetch_list=[loss])
+        pv, = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[path])
+    assert (pv == ys).mean() > 0.98
+
+
+def test_nce_grad_and_training():
+    rng = np.random.RandomState(7)
+    b, d, v = 8, 6, 20
+    x = rng.randn(b, d).astype("float32")
+    y = rng.randint(0, v, (b, 1)).astype("int64")
+
+    def build():
+        xv = layers.data("x", shape=[d], dtype="float32")
+        yv = layers.data("y", shape=[1], dtype="int64")
+        return layers.reduce_sum(
+            layers.nce(xv, yv, v, num_neg_samples=5, seed=13))
+
+    op_test.check_grad(build, {"x": x, "y": y}, ["x"])
+
+
+def test_nce_learns():
+    """NCE-trained tiny classifier: the true class's score should rise
+    above the noise scores (loss decreases substantially)."""
+    rng = np.random.RandomState(8)
+    b, d, v = 64, 8, 50
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[d], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="int64")
+        cost = layers.mean(layers.nce(x, y, v, num_neg_samples=10))
+        fluid.optimizer.Adam(0.05).minimize(cost)
+    exe = fluid.Executor(fluid.CPUPlace())
+    proto = rng.randn(v, d).astype("float32")
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        first = last = None
+        for i in range(60):
+            yb = rng.randint(0, v, (b, 1)).astype("int64")
+            xb = proto[yb[:, 0]] + 0.05 * rng.randn(b, d).astype("float32")
+            l, = exe.run(main, feed={"x": xb, "y": yb}, fetch_list=[cost])
+            first = first if first is not None else float(l)
+            last = float(l)
+    assert last < first * 0.5, (first, last)
+
+
+def test_hsigmoid_probabilities_sum_to_one():
+    """Tree identity: sum_c P(c|x) == 1 where P(c|x)=exp(-cost(c))."""
+    rng = np.random.RandomState(9)
+    d, nc = 5, 7  # non-power-of-two class count
+    x = rng.randn(1, d).astype("float32")
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 2
+    with fluid.program_guard(main, startup):
+        xv = layers.data("x", shape=[d], dtype="float32")
+        yv = layers.data("y", shape=[1], dtype="int64")
+        cost = layers.hsigmoid(xv, yv, nc)
+    exe = fluid.Executor(fluid.CPUPlace())
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        total = 0.0
+        for c in range(nc):
+            cv, = exe.run(main,
+                          feed={"x": x, "y": np.array([[c]], "int64")},
+                          fetch_list=[cost])
+            total += np.exp(-cv[0, 0])
+    np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+def test_hsigmoid_grad():
+    rng = np.random.RandomState(10)
+    b, d, nc = 4, 5, 6
+    x = rng.randn(b, d).astype("float32")
+    y = rng.randint(0, nc, (b, 1)).astype("int64")
+
+    def build():
+        xv = layers.data("x", shape=[d], dtype="float32")
+        yv = layers.data("y", shape=[1], dtype="int64")
+        return layers.reduce_sum(layers.hsigmoid(xv, yv, nc))
+
+    op_test.check_grad(build, {"x": x, "y": y}, ["x"])
+
+
+@pytest.mark.parametrize("loss_type", ["nce", "hsigmoid"])
+def test_word2vec_variants_train(loss_type):
+    from paddle_tpu import models
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        spec = models.word2vec.ngram_lm(dict_size=120, emb_dim=16,
+                                        hidden_size=32,
+                                        loss_type=loss_type)
+        fluid.optimizer.Adam(0.02).minimize(spec.loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    rng = np.random.RandomState(0)
+    with fluid.scope_guard(fluid.Scope()):
+        exe.run(startup)
+        feed = spec.sample_batch(32, rng)
+        first = last = None
+        for _ in range(25):
+            l, = exe.run(main, feed=feed, fetch_list=[spec.loss])
+            first = first if first is not None else float(l)
+            last = float(l)
+    assert last < first, (loss_type, first, last)

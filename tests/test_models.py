@@ -104,3 +104,10 @@ def test_machine_translation_trains():
         src_vocab=40, trg_vocab=40, seq_len=10, emb_dim=16, hid_dim=16)
     _train(spec, batch_size=4, steps=5,
            opt=fluid.optimizer.Adam(learning_rate=3e-3))
+
+
+def test_ocr_ctc_trains():
+    spec = models.ocr_ctc.crnn_ctc(num_classes=12, image_shape=(1, 16, 48),
+                                   max_label_len=6, hid_dim=16)
+    _train(spec, batch_size=4, steps=5,
+           opt=fluid.optimizer.Adam(learning_rate=3e-3))
