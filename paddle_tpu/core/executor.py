@@ -27,7 +27,7 @@ import numpy as np
 
 from . import framework
 from .framework import Program, Variable, convert_np_dtype
-from .op_registry import run_op, RNG_KEY, RNG0_KEY
+from .op_registry import run_op, RNG_KEY, RNG0_KEY, ENV0_KEY
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard",
            "XLAPlace", "TPUPlace", "CPUPlace", "CUDAPlace"]
@@ -147,6 +147,10 @@ def build_step_fn(program, fetch_names, persist_names):
         env.update(feed)
         env[RNG_KEY] = rng
         env[RNG0_KEY] = rng
+        # Step-start snapshot: the autodiff replay re-runs the forward from
+        # here (not from the post-forward env), so in-place ops — e.g. the LR
+        # schedule's step-counter increment — apply exactly once per step.
+        env[ENV0_KEY] = dict(env)
         prev_amp = AMP.enabled
         AMP.enabled = amp  # trace-time flag: fwd + autodiff replay
         try:

@@ -20,6 +20,7 @@ OP_IMPLS = {}
 # rng key threading: reserved env entries
 RNG_KEY = "@RNG@"
 RNG0_KEY = "@RNG0@"  # snapshot at step start, used for autodiff replay
+ENV0_KEY = "@ENV0@"  # dict snapshot of env at step start (autodiff replay base)
 
 
 def register(*names):

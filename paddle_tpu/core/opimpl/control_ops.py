@@ -16,7 +16,7 @@ Control flow: ``cond_block`` / ``while_block`` lower sub-block bodies to
 import jax
 import jax.numpy as jnp
 
-from ..op_registry import register, get, put, run_op, RNG_KEY, RNG0_KEY
+from ..op_registry import register, get, put, run_op, RNG_KEY, RNG0_KEY, ENV0_KEY
 
 
 @register("autodiff")
@@ -47,14 +47,29 @@ def _autodiff(env, op):
     # advanced RNG key). Overwriting them makes the OUTER forward trace dead
     # code — XLA cannot be trusted to CSE the replayed forward against it,
     # and without this the step computes the whole forward twice (measured
-    # ~1.3x step time on the transformer bench).
+    # ~1.3x step time on the transformer bench). Under remat the export is
+    # skipped: making every activation a primal output of the
+    # jax.checkpoint region would keep it live through the backward and
+    # defeat rematerialization.
+    export_aux = not op.attr("remat") and ENV0_KEY in env
     fwd_out_names = set()
-    for f in fwd_ops:
-        fwd_out_names.update(f.output_arg_names)
-    fwd_out_names.add(RNG_KEY)
+    if export_aux:
+        for f in fwd_ops:
+            fwd_out_names.update(f.output_arg_names)
+        fwd_out_names.add(RNG_KEY)
+
+    # The replay must start from the STEP-START env, not the post-forward
+    # env it runs in: in-place ops (the LR step-counter increment) would
+    # otherwise apply twice, and the aux export below would make the doubled
+    # values authoritative.
+    base_env = env.get(ENV0_KEY, env)
 
     def loss_fn(args):
-        local = dict(env)
+        local = dict(base_env)
+        # nested autodiff ops inside the replay must see the same replay
+        # base, or they'd fall back to the mid-replay env and double-apply
+        # in-place ops (the bug this snapshot exists to prevent)
+        local[ENV0_KEY] = base_env
         local.update(args["w"])
         if rng0 is not None:
             local[RNG_KEY] = rng0
@@ -128,16 +143,29 @@ def _autodiff_vjp(env, op):
     tgs = op.input_list("TargetGrads")
     rng0 = env.get(RNG0_KEY)
 
+    base_env = env.get(ENV0_KEY, env)
+    export_aux = ENV0_KEY in env
+    fwd_out_names = set()
+    if export_aux:
+        for fo in fwd_ops:
+            fwd_out_names.update(fo.output_arg_names)
+        fwd_out_names.add(RNG_KEY)
+
     def f(wrt_vals):
-        local = dict(env)
+        local = dict(base_env)
+        local[ENV0_KEY] = base_env
         local.update(wrt_vals)
         if rng0 is not None:
             local[RNG_KEY] = rng0
         for fo in fwd_ops:
             run_op(local, fo)
-        return tuple(local[t.name] for t in targets)
+        # re-export the replayed forward (same dedup rationale as _autodiff)
+        aux = {n: local[n] for n in fwd_out_names if n in local}
+        return tuple(local[t.name] for t in targets), aux
 
-    primals, vjp_fn = jax.vjp(f, {n: env[n] for n in wrt_names})
+    primals, vjp_fn, aux = jax.vjp(f, {n: env[n] for n in wrt_names},
+                                   has_aux=True)
+    env.update(aux)
     if tgs:
         cot = tuple(get(env, t) for t in tgs)
     else:
