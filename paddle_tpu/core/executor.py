@@ -125,10 +125,40 @@ class scope_guard:
 # ---------------------------------------------------------------------------
 
 def _as_array(value, var=None):
+    if isinstance(value, jax.Array):
+        # already-staged device array (e.g. a py_reader prefetch slot or a
+        # caller's jax.device_put): no host round-trip; coerce dtype
+        # device-side like the numpy path below does host-side
+        if (var is not None and var.dtype is not None
+                and not jnp.issubdtype(value.dtype, jax.dtypes.prng_key)):
+            want = jax.dtypes.canonicalize_dtype(np.dtype(var.dtype))
+            if value.dtype != want:
+                value = value.astype(want)
+        return value
     arr = np.asarray(value)
     if var is not None and var.dtype is not None and arr.dtype != var.dtype:
         arr = arr.astype(var.dtype)
     return arr
+
+
+def _make_rng_key(seed):
+    """Threaded PRNG key. On TPU the counter-based ``rbg`` generator is used
+    by default: it maps onto the hardware RNG instruction and is far cheaper
+    than threefry for the per-step dropout masks (threefry lowers to long
+    scalar-ish bit-mix chains that steal MXU-adjacent cycles). Override with
+    PADDLE_TPU_RNG=threefry for bit-exact parity with stock jax keys."""
+    import os
+
+    choice = os.environ.get("PADDLE_TPU_RNG", "")
+    if not choice:
+        try:
+            on_tpu = jax.devices()[0].platform == "tpu"
+        except Exception:
+            on_tpu = False
+        choice = "rbg" if on_tpu else "threefry"
+    if choice == "threefry":
+        return jax.random.PRNGKey(seed)
+    return jax.random.key(seed, impl=choice)
 
 
 def build_step_fn(program, fetch_names, persist_names):
@@ -211,7 +241,7 @@ class Executor:
             else:
                 import secrets
                 seed = secrets.randbits(31)
-            scope.set(RNG_KEY, jax.random.PRNGKey(seed))
+            scope.set(RNG_KEY, _make_rng_key(seed))
 
         persist_names = sorted({v.name for v in program.list_vars()
                                 if v.persistable})
@@ -235,6 +265,14 @@ class Executor:
             def globalize(sharding, arr):
                 if isinstance(arr, jax.Array) and arr.sharding == sharding:
                     return arr
+                if isinstance(arr, jax.Array) and jnp.issubdtype(
+                        arr.dtype, jax.dtypes.prng_key):
+                    # typed PRNG keys (rbg) can't round-trip through numpy;
+                    # globalize the raw key bits and re-wrap
+                    impl = jax.random.key_impl(arr)
+                    data = jax.make_array_from_process_local_data(
+                        repl_sh, np.asarray(jax.random.key_data(arr)))
+                    return jax.random.wrap_key_data(data, impl=impl)
                 return jax.make_array_from_process_local_data(
                     sharding, np.asarray(arr))
 

@@ -19,6 +19,26 @@ import jax.numpy as jnp
 from ..op_registry import register, get, put, run_op, RNG_KEY, RNG0_KEY, ENV0_KEY
 
 
+def _replay_base(env, fwd_ops, export):
+    """(base_env, fwd_out_names) for an autodiff forward replay.
+
+    The replay must start from the STEP-START env snapshot, not the
+    post-forward env the op runs in: in-place ops (e.g. the LR schedule's
+    step-counter increment) would otherwise apply twice. When ``export``,
+    also return the set of names whose replayed values are re-exported into
+    the outer env — overwriting them makes the outer forward trace dead code
+    (XLA cannot be trusted to CSE the replayed forward against it; without
+    the export the step computes the whole forward twice, measured ~1.3x
+    step time on the transformer bench)."""
+    base_env = env.get(ENV0_KEY, env)
+    fwd_out_names = set()
+    if export and ENV0_KEY in env:
+        for f in fwd_ops:
+            fwd_out_names.update(f.output_arg_names)
+        fwd_out_names.add(RNG_KEY)
+    return base_env, fwd_out_names
+
+
 @register("autodiff")
 def _autodiff(env, op):
     fwd_ops = op.attr("fwd_ops")
@@ -43,32 +63,17 @@ def _autodiff(env, op):
 
     dense_wrt = [n for n in wrt_names if n not in sparse_names]
 
-    # Names the replay re-exports into env: every forward output (plus the
-    # advanced RNG key). Overwriting them makes the OUTER forward trace dead
-    # code — XLA cannot be trusted to CSE the replayed forward against it,
-    # and without this the step computes the whole forward twice (measured
-    # ~1.3x step time on the transformer bench). Under remat the export is
-    # skipped: making every activation a primal output of the
-    # jax.checkpoint region would keep it live through the backward and
-    # defeat rematerialization.
-    export_aux = not op.attr("remat") and ENV0_KEY in env
-    fwd_out_names = set()
-    if export_aux:
-        for f in fwd_ops:
-            fwd_out_names.update(f.output_arg_names)
-        fwd_out_names.add(RNG_KEY)
-
-    # The replay must start from the STEP-START env, not the post-forward
-    # env it runs in: in-place ops (the LR step-counter increment) would
-    # otherwise apply twice, and the aux export below would make the doubled
-    # values authoritative.
-    base_env = env.get(ENV0_KEY, env)
+    # Under remat the aux export is skipped: making every activation a
+    # primal output of the jax.checkpoint region would keep it live through
+    # the backward and defeat rematerialization.
+    base_env, fwd_out_names = _replay_base(env, fwd_ops,
+                                           export=not op.attr("remat"))
 
     def loss_fn(args):
         local = dict(base_env)
         # nested autodiff ops inside the replay must see the same replay
         # base, or they'd fall back to the mid-replay env and double-apply
-        # in-place ops (the bug this snapshot exists to prevent)
+        # in-place ops (the bug the step-start snapshot exists to prevent)
         local[ENV0_KEY] = base_env
         local.update(args["w"])
         if rng0 is not None:
@@ -143,13 +148,7 @@ def _autodiff_vjp(env, op):
     tgs = op.input_list("TargetGrads")
     rng0 = env.get(RNG0_KEY)
 
-    base_env = env.get(ENV0_KEY, env)
-    export_aux = ENV0_KEY in env
-    fwd_out_names = set()
-    if export_aux:
-        for fo in fwd_ops:
-            fwd_out_names.update(fo.output_arg_names)
-        fwd_out_names.add(RNG_KEY)
+    base_env, fwd_out_names = _replay_base(env, fwd_ops, export=True)
 
     def f(wrt_vals):
         local = dict(base_env)

@@ -310,6 +310,34 @@ def _softmax_with_cross_entropy(env, op):
     put(env, op.output("Softmax"), jnp.exp(log_p))
 
 
+@register("smooth_softmax_ce")
+def _smooth_softmax_ce(env, op):
+    """Label-smoothed softmax CE in closed form:
+
+        loss = lse(logits) - (1-eps)*logits[y] - (eps/V)*sum(logits)
+
+    ≡ (1-eps)*CE(y) + eps*uniform-CE, but reads the [.., V] logits once and
+    writes only [..] per-token outputs — no [.., V] log-prob or soft-label
+    materialization (the reference pairs ``label_smooth_op.cc`` with
+    ``softmax_with_cross_entropy_op.cc``, building a full soft-label tensor).
+    eps=0 degrades to plain softmax CE. The backward (via autodiff) is
+    softmax(logits) - (1-eps)*onehot - eps/V: one more single pass."""
+    logits = get(env, op.input("Logits"))
+    ids = get(env, op.input("Label")).astype(jnp.int32)
+    if ids.ndim == logits.ndim:
+        ids = ids.squeeze(-1)
+    eps = op.attr("epsilon", 0.0)
+    # fp32 softmax stats regardless of (bf16) logits dtype; the convert
+    # fuses into the reduction's read pass
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
+    logit_y = jnp.take_along_axis(lf, ids[..., None], axis=-1)[..., 0]
+    loss = lse - (1.0 - eps) * logit_y
+    if eps:
+        loss = loss - eps * jnp.mean(lf, axis=-1)
+    put(env, op.output("Loss"), loss)
+
+
 @register("sigmoid_cross_entropy_with_logits")
 def _sigmoid_ce(env, op):
     x = get(env, op.input("X"))

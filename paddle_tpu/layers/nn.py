@@ -19,6 +19,7 @@ __all__ = [
     "depthwise_conv2d", "pool2d", "adaptive_pool2d", "batch_norm",
     "layer_norm", "group_norm", "dropout", "softmax", "log_softmax",
     "cross_entropy", "softmax_with_cross_entropy",
+    "smooth_softmax_with_cross_entropy",
     "sigmoid_cross_entropy_with_logits", "square_error_cost", "smooth_l1",
     "huber_loss", "label_smooth", "kldiv_loss", "bpr_loss", "hinge_loss",
     "log_loss", "margin_rank_loss", "mse_loss",
@@ -729,6 +730,23 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
                      {"soft_label": soft_label, "ignore_index": ignore_index})
     if return_softmax:
         return loss, softmax_out
+    return loss
+
+
+def smooth_softmax_with_cross_entropy(logits, label, epsilon=0.0):
+    """Fused label-smoothed softmax CE (closed form, single logits pass).
+
+    TPU-first replacement for the reference's ``label_smooth`` +
+    ``softmax_with_cross_entropy`` pair (``operators/label_smooth_op.cc``,
+    ``softmax_with_cross_entropy_op.cc``), which materializes a full
+    [..., V] soft-label tensor. Returns per-position loss with the class
+    axis reduced away (shape ``logits.shape[:-1]``)."""
+    helper = LayerHelper("smooth_softmax_with_cross_entropy")
+    loss = helper.create_variable_for_type_inference(
+        dtype="float32", shape=tuple(logits.shape[:-1]))
+    helper.append_op("smooth_softmax_ce",
+                     {"Logits": logits, "Label": label},
+                     {"Loss": loss}, {"epsilon": float(epsilon)})
     return loss
 
 

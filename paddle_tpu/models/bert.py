@@ -80,8 +80,8 @@ def bert_base(vocab_size=30522, seq_len=128, d_model=768, d_ff=3072,
                            param_attr=ParamAttr(name="mlm_out.w",
                                                 sharding=(None, "mp")),
                            name="mlm_out")
-    mlm_ce = layers.squeeze(layers.softmax_with_cross_entropy(
-        mlm_logits, layers.unsqueeze(mlm_labels, [2])), [2])
+    mlm_ce = layers.smooth_softmax_with_cross_entropy(
+        mlm_logits, mlm_labels)  # fused single-pass CE over the vocab
     mlm_loss = layers.elementwise_div(
         layers.reduce_sum(layers.elementwise_mul(mlm_ce, mlm_weights)),
         layers.elementwise_add(

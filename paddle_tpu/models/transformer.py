@@ -113,15 +113,10 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
                                             sharding=(None, "mp")),
                        name="out_proj")
 
-    # analytic label smoothing: (1-e)*CE(label) + e*uniform-CE
-    ce = layers.softmax_with_cross_entropy(logits, layers.unsqueeze(lbl, [2]))
-    ce = layers.squeeze(ce, [2])  # [B, S]
-    if label_smooth_eps:
-        logp = layers.log_softmax(logits)  # [B, S, V]
-        uni = layers.scale(layers.reduce_mean(logp, dim=2), scale=-1.0)
-        ce = layers.elementwise_add(
-            layers.scale(ce, scale=1.0 - label_smooth_eps),
-            layers.scale(uni, scale=label_smooth_eps))
+    # fused closed-form label smoothing: one logits pass, no [B, S, V]
+    # log-prob or soft-label materialization
+    ce = layers.smooth_softmax_with_cross_entropy(
+        logits, lbl, epsilon=label_smooth_eps)  # [B, S]
     mask = layers.sequence_mask(trg_len, maxlen=seq_len, dtype="float32")
     tok_loss = layers.elementwise_mul(ce, mask)
     loss = layers.elementwise_div(layers.reduce_sum(tok_loss),

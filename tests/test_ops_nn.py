@@ -106,3 +106,39 @@ def test_interp_nearest(rng):
     want = x.repeat(2, axis=2).repeat(2, axis=3)
     check_output("nearest_interp", {"X": x}, {"Out": want},
                  {"out_h": 4, "out_w": 4, "align_corners": False})
+
+
+def test_smooth_softmax_ce(rng):
+    """Fused closed-form label-smoothed CE == (1-e)*CE + e*uniform-CE."""
+    b, t, v = 2, 3, 7
+    eps = 0.1
+    logits = rng.randn(b, t, v).astype("float32")
+    label = rng.randint(0, v, size=(b, t)).astype("int64")
+    lse = np.log(np.exp(logits).sum(-1))
+    logp = logits - lse[..., None]
+    ce = -np.take_along_axis(logp, label[..., None], axis=-1)[..., 0]
+    uni = -logp.mean(-1)
+    want = ((1 - eps) * ce + eps * uni).astype("float32")
+    check_output("smooth_softmax_ce", {"Logits": logits, "Label": label},
+                 {"Loss": want}, {"epsilon": eps}, atol=1e-4, rtol=1e-4)
+    # eps=0 degrades to plain softmax CE
+    check_output("smooth_softmax_ce", {"Logits": logits, "Label": label},
+                 {"Loss": ce.astype("float32")}, {"epsilon": 0.0},
+                 atol=1e-4, rtol=1e-4)
+
+
+def test_smooth_softmax_ce_grad(rng):
+    import paddle_tpu as fluid
+    from op_test import check_grad
+
+    logits_np = rng.randn(2, 5).astype("float32")
+    label_np = np.array([1, 3], dtype="int64")
+
+    def build():
+        x = fluid.layers.data("x", shape=[5])
+        y = fluid.layers.data("y", shape=[], dtype="int64")
+        loss = fluid.layers.smooth_softmax_with_cross_entropy(
+            x, y, epsilon=0.2)
+        return fluid.layers.reduce_sum(loss)
+
+    check_grad(build, {"x": logits_np, "y": label_np}, ["x"])
