@@ -1,15 +1,18 @@
-"""Benchmark: Transformer-base NMT training throughput on one chip.
+"""Benchmark: training throughput on one chip for the BASELINE configs.
 
+Default (driver-run): Transformer-base NMT (BASELINE config 3). Select
+others with ``--model resnet50|bert|transformer`` or ``BENCH_MODEL``.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 ``vs_baseline`` is model FLOPs utilization (MFU) relative to the
 BASELINE.json north-star target of 45% MFU (>1.0 beats the target).
 Measurement follows the reference convention of examples/sec
-(``benchmark/fluid/fluid_benchmark.py:297``) expressed per-token.
+(``benchmark/fluid/fluid_benchmark.py:297``), expressed per-token for the
+sequence models.
 """
 
+import argparse
 import json
 import os
-import sys
 import time
 
 import numpy as np
@@ -19,37 +22,69 @@ def _peak_flops(device):
     """Peak bf16 matmul FLOPs/s for the benched chip (fallback 1e14)."""
     kind = getattr(device, "device_kind", "").lower()
     table = {
-        "v5e": 394e12, "v5litepod": 394e12, "v4": 275e12, "v5p": 459e12,
-        "v6e": 918e12, "v3": 123e12, "v2": 45e12,
+        "v5e": 394e12, "v5litepod": 394e12, "v5 lite": 394e12,
+        "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
+        "v4": 275e12, "v3": 123e12, "v2": 45e12,
     }
     for k, v in table.items():
         if k in kind:
             return v
     if device.platform == "cpu":
         return 1e11  # nominal, for smoke runs
-    return 1e14
+    return 394e12  # assume v5e-class if unrecognized
+
+
+def _build(model, on_tpu):
+    """Returns (spec_builder_result, batch, metric_name, unit, per_example)."""
+    from paddle_tpu import models
+
+    if model == "transformer":
+        seq_len = 256 if on_tpu else 64
+        spec = models.transformer.transformer_base(
+            seq_len=seq_len, dropout_rate=0.1)
+        batch = 128 if on_tpu else 4
+        return (spec, batch, "transformer_base_tokens_per_sec_per_chip",
+                "tokens/sec", spec.tokens_per_example)
+    if model == "bert":
+        seq_len = 128 if on_tpu else 32
+        spec = models.bert.bert_base(seq_len=seq_len) if on_tpu else \
+            models.bert.bert_base(vocab_size=1000, seq_len=seq_len,
+                                  d_model=128, d_ff=256, n_layer=2)
+        batch = 128 if on_tpu else 4
+        return (spec, batch, "bert_base_tokens_per_sec_per_chip",
+                "tokens/sec", spec.tokens_per_example)
+    if model == "resnet50":
+        spec = models.resnet.resnet_imagenet(depth=50) if on_tpu else \
+            models.resnet.resnet_imagenet(depth=50, class_num=10,
+                                          image_shape=(3, 64, 64))
+        batch = 128 if on_tpu else 2
+        return (spec, batch, "resnet50_images_per_sec_per_chip",
+                "images/sec", 1)
+    raise SystemExit("unknown model %r" % model)
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=os.environ.get("BENCH_MODEL",
+                                                      "transformer"),
+                    choices=["transformer", "bert", "resnet50"])
+    args = ap.parse_args()
+
     import jax
     import paddle_tpu as fluid
-    from paddle_tpu import models
 
     on_tpu = jax.devices()[0].platform == "tpu"
-    seq_len = 256
-    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
-    steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
-    if not on_tpu:
-        seq_len = 64
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
-        spec = models.transformer.transformer_base(
-            seq_len=seq_len, dropout_rate=0.1)
+        spec, batch, metric, unit, per_example = _build(args.model, on_tpu)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
         if os.environ.get("BENCH_AMP", "1") == "1":
             opt = fluid.amp.decorate(opt)  # bf16 MXU compute
         opt.minimize(spec.loss)
+
+    batch = int(os.environ.get("BENCH_BATCH", batch))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
 
     exe = fluid.Executor(fluid.XLAPlace(0))
     scope = fluid.Scope()
@@ -73,14 +108,13 @@ def main():
         np.asarray(loss_val)  # sync
         dt = time.perf_counter() - t0
 
-    tokens_per_step = batch * spec.tokens_per_example
-    tokens_per_sec = tokens_per_step * steps / dt
-    flops_per_step = spec.flops_per_example * batch
+    examples_per_sec = batch * per_example * steps / dt
+    flops_per_step = (spec.flops_per_example or 0) * batch
     mfu = (flops_per_step * steps / dt) / _peak_flops(jax.devices()[0])
     out = {
-        "metric": "transformer_base_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec",
+        "metric": metric,
+        "value": round(examples_per_sec, 1),
+        "unit": unit,
         "vs_baseline": round(mfu / 0.45, 4),
     }
     print(json.dumps(out))

@@ -33,8 +33,12 @@ def _conv2d(env, op):
     groups = op.attr("groups", 1)
     if op.type == "depthwise_conv2d":
         groups = x.shape[1]
-    from ..op_registry import mxu_cast, mxu_acc_dtype
+    from ..op_registry import mxu_cast
     x, w = mxu_cast(x, w)
+    # bf16 in -> bf16 out under AMP: the TPU conv unit accumulates fp32
+    # internally and rounds once at the output. (An explicit f32
+    # preferred_element_type would break lax's conv transpose rule, which
+    # requires cotangent and operand dtypes to match.)
     out = jax.lax.conv_general_dilated(
         x, w,
         window_strides=strides,
@@ -42,7 +46,6 @@ def _conv2d(env, op):
         rhs_dilation=dil,
         feature_group_count=groups,
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        preferred_element_type=mxu_acc_dtype(x),
     )
     put(env, op.output("Output"), out)
 
@@ -159,6 +162,13 @@ def _batch_norm(env, op):
     c_shape = [1] * x.ndim
     c_shape[1 if layout == "NCHW" else x.ndim - 1] = -1
 
+    # stats + normalization in fp32 even for bf16 inputs (AMP): the casts
+    # fuse into the reduction/epilogue reads. The normalized output is
+    # stored back in the input dtype — keeping activations bf16 between
+    # conv layers halves HBM traffic, and the next conv recasts anyway.
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+
     if is_test or op.attr("use_global_stats", False):
         use_mean, use_var = mean, var
         put(env, op.output("MeanOut"), mean)
@@ -177,7 +187,7 @@ def _batch_norm(env, op):
     inv = jax.lax.rsqrt(use_var.reshape(c_shape) + eps)
     y = (x - use_mean.reshape(c_shape)) * inv * scale.reshape(c_shape) \
         + bias.reshape(c_shape)
-    put(env, op.output("Y"), y)
+    put(env, op.output("Y"), y.astype(in_dtype))
 
 
 @register("layer_norm")
