@@ -62,6 +62,9 @@ class CompiledProgram:
         self._build_strategy = None
         self._exec_strategy = None
         self._seq_feeds = None
+        self._pp_axis = None
+        self._pp_boundaries = None
+        self._pp_nmicro = None
 
     def with_data_parallel(self, loss_name=None, build_strategy=None,
                            exec_strategy=None, share_vars_from=None,
@@ -84,6 +87,33 @@ class CompiledProgram:
         self._places = places
         return self
 
+    def with_pipeline(self, loss_name=None, mesh=None, pp_axis="pp",
+                      boundaries=None, n_microbatches=None):
+        """Pipeline-parallel training over ``mesh``'s ``pp_axis``.
+
+        The program's forward is split into ``mesh.shape[pp_axis]`` stages
+        at the producers of the named ``boundaries`` variables; each device
+        runs its stage, microbatches ride a ppermute ring, and the backward
+        (via the program's autodiff op) follows the GPipe reverse schedule.
+        New TPU-first capability — the 2019 reference has no pipeline
+        engine (SURVEY §2.5D); contrast ``pipeline_apply`` for the raw
+        homogeneous-stack form.
+
+        Per-microbatch losses are averaged (the data-parallel convention).
+        Fetching forward activations other than the loss falls back to a
+        replicated recompute of those ops. ``n_microbatches`` defaults to
+        the number of stages."""
+        if not boundaries:
+            raise ValueError("with_pipeline requires boundaries: the "
+                             "activation var names to cut stages at")
+        self._pp_axis = pp_axis
+        self._pp_boundaries = tuple(
+            b.name if hasattr(b, "name") else str(b) for b in boundaries)
+        self._pp_nmicro = n_microbatches
+        self._mesh = mesh
+        self._places = None
+        return self
+
     def with_inference_optimize(self, config=None):
         # analysis passes are subsumed by XLA; keep chainable API
         return self
@@ -94,5 +124,6 @@ class CompiledProgram:
         from jax.sharding import Mesh
         import numpy as np
         devices = self._places or jax.devices()
-        self._mesh = Mesh(np.array(devices), (self._dp_axis or "dp",))
+        axis = self._pp_axis or self._dp_axis or "dp"
+        self._mesh = Mesh(np.array(devices), (axis,))
         return self._mesh

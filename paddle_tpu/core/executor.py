@@ -161,22 +161,26 @@ def _make_rng_key(seed):
     return jax.random.key(seed, impl=choice)
 
 
-def build_step_fn(program, fetch_names, persist_names):
+def build_step_fn(program, fetch_names, persist_names, pp_cfg=None):
     """Trace a program's global block into one pure function
     ``(state, feed, rng) -> (fetches, new_state, rng')`` — the unit the
-    Executor jits, ``__graft_entry__`` exposes, and bench.py times."""
+    Executor jits, ``__graft_entry__`` exposes, and bench.py times.
+    ``pp_cfg`` routes the autodiff replay through the pipeline engine
+    (see ``parallel/pipeline.py``)."""
     ops = list(program.global_block().ops)
     persist_set = set(persist_names)
     amp = bool(getattr(program, "_amp_bf16", False))
 
     def step(state, feed, rng):
-        from .op_registry import AMP
+        from .op_registry import AMP, PP_KEY
 
         env = {}
         env.update(state)
         env.update(feed)
         env[RNG_KEY] = rng
         env[RNG0_KEY] = rng
+        if pp_cfg is not None:
+            env[PP_KEY] = pp_cfg
         # Step-start snapshot: the autodiff replay re-runs the forward from
         # here (not from the post-forward env), so in-place ops — e.g. the LR
         # schedule's step-counter increment — apply exactly once per step.
@@ -212,11 +216,15 @@ class Executor:
         dp_axis = None
         sp_axis = None
         seq_feeds = None
+        pp = None
         if isinstance(program, CompiledProgram):
             mesh = program._resolve_mesh()
             dp_axis = program._dp_axis
             sp_axis = program._sp_axis
             seq_feeds = program._seq_feeds
+            if program._pp_axis is not None:
+                pp = (program._pp_axis, program._pp_boundaries,
+                      program._pp_nmicro)
             program = program._program
         if scope is None:
             scope = global_scope()
@@ -285,12 +293,13 @@ class Executor:
         feed_sig = tuple(sorted(
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
-               state_in_names, id(scope), mesh, dp_axis, sp_axis, seq_feeds)
+               state_in_names, id(scope), mesh, dp_axis, sp_axis, seq_feeds,
+               pp)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._compile(program, tuple(sorted(feed_arrays)),
                                   fetch_names, state_in_names, persist_names,
-                                  mesh, dp_axis, sp_axis, seq_feeds)
+                                  mesh, dp_axis, sp_axis, seq_feeds, pp)
             if use_program_cache:
                 self._cache[key] = entry
         jfn = entry
@@ -372,6 +381,10 @@ class Executor:
                     % (sorted(sp_names), sp_axis))
 
         def feed_spec(name):
+            if dp_axis is None or dp_axis not in mesh_axes:
+                # no data-parallel axis (e.g. a pipeline-only mesh):
+                # feeds stay replicated, the engine slices microbatches
+                return repl
             if name in sp_names:
                 return NamedSharding(mesh, P(dp_axis, sp_axis))
             return NamedSharding(mesh, P(dp_axis))
@@ -394,8 +407,16 @@ class Executor:
         return in_shardings, out_shardings
 
     def _compile(self, program, feed_names, fetch_names, state_in_names,
-                 persist_names, mesh, dp_axis, sp_axis=None, seq_feeds=None):
-        step = build_step_fn(program, fetch_names, persist_names)
+                 persist_names, mesh, dp_axis, sp_axis=None, seq_feeds=None,
+                 pp=None):
+        pp_cfg = None
+        if pp is not None:
+            pp_axis, pp_boundaries, pp_nmicro = pp
+            pp_cfg = {"mesh": mesh, "axis": pp_axis,
+                      "boundaries": list(pp_boundaries),
+                      "n_micro": pp_nmicro, "feed_names": list(feed_names)}
+        step = build_step_fn(program, fetch_names, persist_names,
+                             pp_cfg=pp_cfg)
         donate = (0,)
         if mesh is None:
             return jax.jit(step, donate_argnums=donate)

@@ -472,19 +472,18 @@ class Program:
         if not isinstance(targets, (list, tuple)):
             targets = [targets]
         needed = {t.name if isinstance(t, Variable) else t for t in targets}
-        kept = []
-        for op in reversed(self.global_block().ops):
-            if set(op.output_arg_names) & needed:
-                kept.append(op)
-                needed |= set(op.input_arg_names)
-        kept.reverse()
+        ops = self.global_block().ops
+        kept_idx = set()
+        for i in range(len(ops) - 1, -1, -1):
+            if set(ops[i].output_arg_names) & needed:
+                kept_idx.add(i)
+                needed |= set(ops[i].input_arg_names)
+        # clone preserves op order 1:1, so filter by position — two
+        # identical-signature ops (e.g. two dropouts of the same var) must
+        # not alias each other
         p = self.clone()
-        name_set = {o.type for o in kept}  # noqa: F841 (debug aid)
-        kept_sig = [(o.type, tuple(o.input_arg_names), tuple(o.output_arg_names)) for o in kept]
         nb = p.global_block()
-        nb.ops = [o for o in nb.ops
-                  if (o.type, tuple(o.input_arg_names), tuple(o.output_arg_names)) in
-                  set(kept_sig)]
+        nb.ops = [o for i, o in enumerate(nb.ops) if i in kept_idx]
         p._version += 1
         return p
 

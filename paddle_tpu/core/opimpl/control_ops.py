@@ -16,7 +16,8 @@ Control flow: ``cond_block`` / ``while_block`` lower sub-block bodies to
 import jax
 import jax.numpy as jnp
 
-from ..op_registry import register, get, put, run_op, RNG_KEY, RNG0_KEY, ENV0_KEY
+from ..op_registry import (register, get, put, run_op, RNG_KEY, RNG0_KEY,
+                           ENV0_KEY, PP_KEY)
 
 
 def _replay_base(env, fwd_ops, export):
@@ -68,6 +69,39 @@ def _autodiff(env, op):
     # the backward and defeat rematerialization.
     base_env, fwd_out_names = _replay_base(env, fwd_ops,
                                            export=not op.attr("remat"))
+
+    pp_cfg = env.get(PP_KEY)
+    if pp_cfg is not None:
+        # pipeline-parallel replay: the forward runs as a microbatched
+        # stage pipeline over the pp mesh axis; jax.grad through it yields
+        # the GPipe reverse schedule. Only the loss is re-exported — any
+        # other fetched forward output falls back to the (replicated)
+        # outer trace, and unfetched outer compute is DCE'd by XLA.
+        if sites:
+            raise NotImplementedError(
+                "sparse gradients are not supported under pipeline "
+                "parallelism yet; unset is_sparse_grad on %s"
+                % sorted(sparse_names))
+        from ...parallel.pipeline import pipeline_program_loss
+
+        pp_loss = pipeline_program_loss(
+            base_env, fwd_ops, loss_var.name, pp_cfg, run_op,
+            rng0 if rng0 is not None else jax.random.PRNGKey(0),
+            shape_env=env)
+        if op.attr("remat"):
+            # recompute each microbatch's stages in the backward instead of
+            # keeping every scan-stashed activation live
+            pp_loss = jax.checkpoint(pp_loss)
+        args = {n: env[n] for n in dense_wrt}
+        grads_w, aux = jax.grad(pp_loss, has_aux=True)(args)
+        env.update(aux)
+        callback = op.attr("grad_callback")
+        for name, v in zip(wrt_names, op.output_list("Grads")):
+            g = grads_w[name]
+            if callback is not None:
+                g = callback(name, g)
+            put(env, v, g)
+        return
 
     def loss_fn(args):
         local = dict(base_env)

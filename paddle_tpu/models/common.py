@@ -38,12 +38,14 @@ class ModelSpec:
     """
 
     def __init__(self, loss, feeds, fetches=None, flops_per_example=None,
-                 tokens_per_example=None):
+                 tokens_per_example=None, extras=None):
         self.loss = loss
         self.feeds = feeds
         self.fetches = dict(fetches or {})
         self.flops_per_example = flops_per_example
         self.tokens_per_example = tokens_per_example
+        # named internal vars (e.g. pipeline cut points, block outputs)
+        self.extras = dict(extras or {})
 
     def feed_names(self):
         return list(self.feeds.keys())

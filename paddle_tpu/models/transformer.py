@@ -77,6 +77,7 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
 
     src_bias = _pad_bias(src_len, seq_len)
     enc = _embed(src, pos, src_vocab, d_model, dropout_rate, "src")
+    block_outs = []  # per-block output var names: pipeline cut points
     for i in range(n_layer):
         nm = "enc%d" % i
         enc = _prenorm(
@@ -87,6 +88,7 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
         enc = _prenorm(enc, lambda x: _ffn(x, d_model, d_ff, nm + "_ffn",
                                            moe_experts, moe_k, aux_losses),
                        dropout_rate, nm + "_ffn")
+        block_outs.append(enc.name)
     enc = layers.layer_norm(enc, begin_norm_axis=2)
 
     dec = _embed(trg, pos, trg_vocab, d_model, dropout_rate, "trg")
@@ -105,6 +107,7 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
         dec = _prenorm(dec, lambda x: _ffn(x, d_model, d_ff, nm + "_ffn",
                                            moe_experts, moe_k, aux_losses),
                        dropout_rate, nm + "_ffn")
+        block_outs.append(dec.name)
     dec = layers.layer_norm(dec, begin_norm_axis=2)
 
     logits = layers.fc(dec, size=trg_vocab, num_flatten_dims=2,
@@ -138,7 +141,8 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
         flops_per_example=transformer_flops_per_token(
             src_vocab, trg_vocab, seq_len, d_model, d_ff, n_head,
             n_layer) * seq_len,
-        tokens_per_example=seq_len)
+        tokens_per_example=seq_len,
+        extras={"enc_out": enc.name, "block_outs": block_outs})
 
 
 def transformer_flops_per_token(src_vocab, trg_vocab, seq_len, d_model, d_ff,

@@ -201,3 +201,86 @@ def test_moe_dispatch_weights_sum():
     # cross-round slot offset — collisions silently blend tokens)
     assert float(np.asarray(dispatch.sum(axis=0)).max()) <= 1.0 + 1e-6
     assert float(aux) > 0
+
+
+# ---------------------------------------------------------------------------
+# pipeline parallelism through the Program path (CompiledProgram.with_pipeline)
+# ---------------------------------------------------------------------------
+
+def _build_chain_program(seed=3):
+    """4-stage MLP chain with named cut points; returns (spec-ish tuple)."""
+    fluid.unique_name.switch()
+    x = fluid.layers.data("x", shape=[16])
+    y = fluid.layers.data("y", shape=[1])
+    h = x
+    cuts = []
+    for i in range(4):
+        h = fluid.layers.fc(h, size=16, act="tanh", name="blk%d" % i)
+        if i < 3:
+            cuts.append(h.name)
+    pred = fluid.layers.fc(h, size=1, name="head")
+    loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+    return loss, cuts
+
+
+def test_pipeline_program_matches_single_device():
+    rng = np.random.RandomState(7)
+    xs = rng.randn(8, 16).astype("float32")
+    ys = rng.randn(8, 1).astype("float32")
+
+    def run(pipeline):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 11
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            loss, cuts = _build_chain_program()
+            fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            prog = main
+            if pipeline:
+                mesh = _mesh((4,), ("pp",))
+                prog = fluid.CompiledProgram(main).with_pipeline(
+                    loss_name=loss.name, mesh=mesh, boundaries=cuts,
+                    n_microbatches=4)
+            losses = []
+            for _ in range(4):
+                lv, = exe.run(prog, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                losses.append(float(lv))
+            w = scope.numpy("blk0.w_0_0")
+        return losses, w
+
+    ref_losses, ref_w = run(pipeline=False)
+    pp_losses, pp_w = run(pipeline=True)
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(pp_w, ref_w, rtol=2e-4, atol=1e-5)
+
+
+def test_pipeline_transformer_smoke():
+    """Enc-dec transformer under pp=2: heterogeneous carry (enc output
+    crosses every decoder boundary); loss finite and decreasing."""
+    mesh = _mesh((2,), ("pp",))
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        spec = models.transformer.transformer_base(
+            src_vocab=64, trg_vocab=64, seq_len=8, d_model=16, d_ff=32,
+            n_head=2, n_layer=2, dropout_rate=0.0)
+        fluid.optimizer.Adam(learning_rate=2e-3).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        # cut after the encoder stack: stage 0 = encoder (+embeds),
+        # stage 1 = decoder + loss
+        cut = spec.extras["enc_out"]
+        prog = fluid.CompiledProgram(main).with_pipeline(
+            loss_name=spec.loss.name, mesh=mesh, boundaries=[cut],
+            n_microbatches=2)
+        feed = spec.sample_batch(4, np.random.RandomState(0))
+        losses = [float(exe.run(prog, feed=feed,
+                                fetch_list=[spec.loss])[0])
+                  for _ in range(6)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0], losses
