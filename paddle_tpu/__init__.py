@@ -40,6 +40,7 @@ from . import clip  # noqa: F401
 from . import metrics  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import data  # noqa: F401
 from .data.feeder import DataFeeder  # noqa: F401

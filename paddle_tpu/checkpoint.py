@@ -1,0 +1,325 @@
+"""Sharded + async training checkpoints.
+
+Reference capabilities covered (re-designed for a GSPMD mesh):
+  * ``fluid.io.save_checkpoint`` / ``load_checkpoint`` — versioned
+    ``checkpoint_<n>`` dirs, ``latest`` marker, max_num_checkpoints
+    trimming (ref ``python/paddle/fluid/io.py`` checkpoint family).
+  * ``_save_distributed_persistables`` (ref ``io.py:261``) +
+    checkpoint_notify (ref ``distribute_transpiler.py:1457``) — on a
+    sharded mesh every process writes ONLY its addressable shards (one
+    ``shards_p<proc>.npz`` per process + slice manifest), instead of
+    gathering every parameter onto host 0.
+
+TPU-native design notes: arrays are snapshotted device->host synchronously
+(the executor donates state buffers on the next step, so the snapshot cannot
+be deferred), then the disk write runs on a background thread —
+``save_checkpoint(...).wait()`` joins it. Replicated arrays are written once
+by process 0 only; sharded arrays are written piecewise with their global
+slice indices and reassembled on load.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+
+import numpy as np
+
+from .core import framework
+from .core.executor import global_scope
+
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter"]
+
+_MANIFEST = "checkpoint_manifest.json"
+
+
+class CheckpointWriter:
+    """Handle for an in-flight async checkpoint write."""
+
+    def __init__(self, thread, path):
+        self._thread = thread
+        self.path = path
+        self.error = None
+
+    def wait(self):
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        if self.error is not None:
+            raise self.error
+        return self.path
+
+    def done(self):
+        return self._thread is None or not self._thread.is_alive()
+
+
+def _process_index():
+    import jax
+
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def _snapshot(value):
+    """Device -> host snapshot of one scope entry.
+
+    Returns ("replicated", np.ndarray) or
+    ("sharded", global_shape, dtype, [(slice_tuple, np.ndarray), ...])
+    listing only this process's addressable shards (deduplicated by index).
+    """
+    import jax
+
+    if not isinstance(value, jax.Array):
+        return ("replicated", np.asarray(value))
+    sharding = value.sharding
+    if sharding.is_fully_replicated:
+        return ("replicated", np.asarray(value))
+    seen = {}
+    for sh in value.addressable_shards:
+        # normalize index: slice(None) -> full extent
+        norm = []
+        for dim, s in enumerate(sh.index):
+            start = 0 if s.start is None else int(s.start)
+            stop = (value.shape[dim] if s.stop is None else int(s.stop))
+            norm.append((start, stop))
+        key = tuple(norm)
+        if key not in seen:
+            seen[key] = np.asarray(sh.data)
+    return ("sharded", tuple(value.shape), str(value.dtype),
+            sorted(seen.items()))
+
+
+def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
+                    main_program=None, max_num_checkpoints=3,
+                    scope=None, async_write=True, extra_meta=None):
+    """Write a versioned checkpoint of every persistable (params + optimizer
+    accumulators + counters). Returns a :class:`CheckpointWriter`; call
+    ``.wait()`` to block until the files are on disk."""
+    main_program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    proc, nproc = _process_index()
+
+    persist = [v for v in main_program.list_vars() if v.persistable]
+    replicated = {}
+    sharded = {}
+    manifest_vars = {}
+    # the scope's threaded RNG stream: without it a resume restarts
+    # dropout randomness from the seed and diverges from an
+    # uninterrupted run
+    rng_meta = None
+    from .core.op_registry import RNG_KEY
+    import jax
+
+    if RNG_KEY in scope and proc == 0:
+        key = scope.get(RNG_KEY)
+        if jax.dtypes.issubdtype(key.dtype, jax.dtypes.prng_key):
+            impl = jax.random.key_impl(key)
+            rng_meta = {"impl": getattr(impl, "name", None) or str(impl)}
+            replicated["@RNG@"] = np.asarray(jax.random.key_data(key))
+        else:
+            rng_meta = {"impl": None}  # legacy raw uint32 key
+            replicated["@RNG@"] = np.asarray(key)
+    for v in persist:
+        if v.name not in scope:
+            continue
+        snap = _snapshot(scope.get(v.name))
+        if snap[0] == "replicated":
+            arr = snap[1]
+            manifest_vars[v.name] = {
+                "kind": "replicated", "shape": list(arr.shape),
+                "dtype": str(arr.dtype)}
+            if proc == 0:
+                replicated[v.name] = arr
+        else:
+            _, gshape, dtype, pieces = snap
+            manifest_vars[v.name] = {
+                "kind": "sharded", "shape": list(gshape), "dtype": dtype,
+                "pieces": {
+                    "p%d" % proc: [list(map(list, idx)) for idx, _ in pieces]
+                }}
+            for k, (idx, arr) in enumerate(pieces):
+                sharded["%s@%d" % (v.name, k)] = arr
+
+    # next version number (process 0 decides; others follow the marker the
+    # caller coordinates — single-host multi-device writes happen in one
+    # process anyway)
+    os.makedirs(checkpoint_dir, exist_ok=True)
+    existing = [int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)
+                if d.startswith("checkpoint_") and
+                d.split("_")[1].isdigit()]
+    version = (max(existing) + 1) if existing else 0
+    vdir = os.path.join(checkpoint_dir, "checkpoint_%d" % version)
+    os.makedirs(vdir, exist_ok=True)
+
+    manifest = {
+        "version": version,
+        "nproc": nproc,
+        "vars": manifest_vars,
+        "rng": rng_meta,
+        "extra": extra_meta or {},
+    }
+
+    # writers serialize in submission order: a later checkpoint must not
+    # have its 'latest' marker or _trim overtaken by an earlier in-flight
+    # writer thread
+    global _last_writer
+    prev = _last_writer
+
+    def write():
+        try:
+            if prev is not None and prev._thread is not None:
+                prev._thread.join()
+            if replicated:
+                _savez_atomic(os.path.join(vdir, "replicated.npz"),
+                              replicated)
+            if sharded:
+                _savez_atomic(os.path.join(vdir, "shards_p%d.npz" % proc),
+                              sharded)
+            if proc == 0:
+                # merge per-process piece indices written by others is a
+                # load-time concern; each process writes its own manifest
+                with open(os.path.join(vdir, _MANIFEST), "w") as f:
+                    json.dump(manifest, f, indent=1)
+                with open(os.path.join(checkpoint_dir, "latest.tmp"),
+                          "w") as f:
+                    f.write("checkpoint_%d" % version)
+                os.replace(os.path.join(checkpoint_dir, "latest.tmp"),
+                           os.path.join(checkpoint_dir, "latest"))
+                _trim(checkpoint_dir, max_num_checkpoints)
+            else:
+                with open(os.path.join(
+                        vdir, "manifest_p%d.json" % proc), "w") as f:
+                    json.dump(manifest, f, indent=1)
+        except BaseException as e:  # surfaced via .wait()
+            writer.error = e
+
+    if async_write:
+        t = threading.Thread(target=write, name="ckpt-writer", daemon=True)
+        writer = CheckpointWriter(t, vdir)
+        _last_writer = writer
+        t.start()
+    else:
+        if prev is not None and prev._thread is not None:
+            prev._thread.join()
+        writer = CheckpointWriter(None, vdir)
+        _last_writer = writer
+        write()
+    return writer
+
+
+_last_writer = None
+
+
+def _savez_atomic(path, arrays):
+    from .io import _atomic_savez  # shared tmp+rename npz writer
+
+    _atomic_savez(path, arrays)
+
+
+def _trim(checkpoint_dir, keep):
+    if not keep or keep <= 0:
+        return
+    versions = sorted(
+        int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)
+        if d.startswith("checkpoint_") and d.split("_")[1].isdigit())
+    for v in versions[:-keep]:
+        shutil.rmtree(os.path.join(checkpoint_dir, "checkpoint_%d" % v),
+                      ignore_errors=True)
+
+
+def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
+                    main_program=None, scope=None, version=None):
+    """Restore every persistable from the newest (or given) checkpoint.
+    Sharded vars are reassembled from all processes' piece files; the next
+    ``exe.run`` re-shards them onto the mesh. Returns the manifest's
+    ``extra`` metadata dict."""
+    import jax.numpy as jnp
+
+    main_program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    if version is None:
+        with open(os.path.join(checkpoint_dir, "latest")) as f:
+            vname = f.read().strip()
+    else:
+        vname = "checkpoint_%d" % version
+    vdir = os.path.join(checkpoint_dir, vname)
+    with open(os.path.join(vdir, _MANIFEST)) as f:
+        manifest = json.load(f)
+
+    repl_path = os.path.join(vdir, "replicated.npz")
+    repl = np.load(repl_path, allow_pickle=False) if \
+        os.path.exists(repl_path) else {}
+
+    # per-process piece indices: primary manifest (p0) + the secondary
+    # manifests other processes wrote next to their shard files
+    piece_index = {}  # var name -> [(proc, [idx, ...])]
+    for pf in [os.path.join(vdir, _MANIFEST)] + [
+            os.path.join(vdir, f) for f in sorted(os.listdir(vdir))
+            if f.startswith("manifest_p")]:
+        with open(pf) as f:
+            m = json.load(f)
+        for name, meta in m["vars"].items():
+            for pkey, idxs in meta.get("pieces", {}).items():
+                piece_index.setdefault(name, []).append(
+                    (int(pkey[1:]), idxs))
+
+    persist = {v.name for v in main_program.list_vars() if v.persistable}
+    shard_cache = {}
+    for name, meta in manifest["vars"].items():
+        if name not in persist:
+            continue
+        if meta["kind"] == "replicated":
+            if name in repl:
+                scope.set(name, jnp.asarray(repl[name]))
+            continue
+        full = np.zeros(tuple(meta["shape"]), dtype=meta["dtype"])
+        # boolean coverage mask: piece indices may overlap across processes
+        # (dp-replicated, mp-sharded layouts), so a counter can't validate
+        covered = np.zeros(tuple(meta["shape"]), dtype=bool)
+        for pnum, idxs in piece_index.get(name, ()):
+            if pnum not in shard_cache:
+                sf_path = os.path.join(vdir, "shards_p%d.npz" % pnum)
+                shard_cache[pnum] = (np.load(sf_path, allow_pickle=False)
+                                     if os.path.exists(sf_path) else None)
+            sf = shard_cache[pnum]
+            if sf is None:
+                raise IOError(
+                    "checkpoint %s: shard file shards_p%d.npz (pieces of "
+                    "%r) is missing — refusing to restore zero-filled "
+                    "weights" % (vdir, pnum, name))
+            for k, idx in enumerate(idxs):
+                key = "%s@%d" % (name, k)
+                if key not in sf:
+                    raise IOError(
+                        "checkpoint %s: piece %s missing from "
+                        "shards_p%d.npz" % (vdir, key, pnum))
+                sl = tuple(slice(a, b) for a, b in idx)
+                full[sl] = sf[key]
+                covered[sl] = True
+        if not covered.all():
+            raise IOError(
+                "checkpoint %s: pieces of %r cover %d of %d elements — "
+                "a process's shard file was never written (save on every "
+                "process, or the fs lost one)"
+                % (vdir, name, int(covered.sum()), covered.size))
+        scope.set(name, jnp.asarray(full))
+
+    # restore the threaded RNG stream so dropout randomness resumes
+    # exactly where the interrupted run left off
+    rng_meta = manifest.get("rng")
+    if rng_meta is not None and "@RNG@" in repl:
+        import jax
+
+        data = np.asarray(repl["@RNG@"])
+        if rng_meta.get("impl"):
+            key = jax.random.wrap_key_data(jnp.asarray(data),
+                                           impl=rng_meta["impl"])
+        else:
+            key = jnp.asarray(data)
+        from .core.op_registry import RNG_KEY
+
+        scope.set(RNG_KEY, key)
+    return manifest.get("extra", {})

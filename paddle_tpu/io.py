@@ -23,8 +23,21 @@ from .core.framework import Parameter
 __all__ = [
     "save_vars", "save_params", "save_persistables", "load_vars",
     "load_params", "load_persistables", "save_inference_model",
-    "load_inference_model",
+    "load_inference_model", "save_checkpoint", "load_checkpoint",
 ]
+
+
+def save_checkpoint(*args, **kwargs):
+    """Ref ``fluid.io`` checkpoint family; see ``paddle_tpu.checkpoint``."""
+    from .checkpoint import save_checkpoint as impl
+
+    return impl(*args, **kwargs)
+
+
+def load_checkpoint(*args, **kwargs):
+    from .checkpoint import load_checkpoint as impl
+
+    return impl(*args, **kwargs)
 
 
 def _collect(program, predicate):
