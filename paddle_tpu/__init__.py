@@ -41,6 +41,7 @@ from . import metrics  # noqa: F401
 from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import inference  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import data  # noqa: F401
 from .data.feeder import DataFeeder  # noqa: F401

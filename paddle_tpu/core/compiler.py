@@ -29,9 +29,10 @@ class ExecutionStrategy:
 
 class BuildStrategy:
     """Ref ``details/build_strategy.h:35-140``. ``reduce_strategy=Reduce``
-    maps to sharding optimizer state across the dp axis (ZeRO-style) — the
-    capability the reference implements with ReduceOpHandle parameter-
-    partitioning."""
+    shards optimizer accumulators over the dp axis (ZeRO-style; see
+    ``executor._mesh_shardings``) — the capability the reference implements
+    with ReduceOpHandle parameter-partitioning. Verified by
+    ``tests/test_parallel.py::test_zero_reduce_strategy_shards_optimizer_state``."""
 
     class ReduceStrategy:
         AllReduce = 0

@@ -439,6 +439,11 @@ class Program:
                         nb, name=name, shape=v.shape, dtype=v.dtype,
                         persistable=v.persistable, stop_gradient=v.stop_gradient,
                         lod_level=v.lod_level, is_data=v.is_data)
+                    # mesh/ZeRO annotations must survive cloning
+                    if getattr(v, "sharding", None) is not None:
+                        nv.sharding = v.sharding
+                    if getattr(v, "is_optimizer_state", False):
+                        nv.is_optimizer_state = True
                 nb.vars[name] = nv
                 var_map[(b.idx, name)] = nv
 
@@ -472,10 +477,17 @@ class Program:
         if not isinstance(targets, (list, tuple)):
             targets = [targets]
         needed = {t.name if isinstance(t, Variable) else t for t in targets}
+        # persistables are STATE (resolved from the scope), not products:
+        # without this, pruning to an inference target chases params back
+        # through the optimizer ops and drags the whole backward along.
+        # The user's explicit targets stay producible even when persistable
+        # (e.g. fetching an EMA/global var the program computes).
+        persistable = {v.name for v in self.list_vars()
+                       if v.persistable} - set(needed)
         ops = self.global_block().ops
         kept_idx = set()
         for i in range(len(ops) - 1, -1, -1):
-            if set(ops[i].output_arg_names) & needed:
+            if set(ops[i].output_arg_names) & (needed - persistable):
                 kept_idx.add(i)
                 needed |= set(ops[i].input_arg_names)
         # clone preserves op order 1:1, so filter by position — two

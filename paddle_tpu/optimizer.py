@@ -80,6 +80,9 @@ class Optimizer:
         # shard; here: same PartitionSpec, so sharded optimizers stay local)
         if tuple(shape) == tuple(param.shape):
             var.sharding = getattr(param, "sharding", None)
+        # marks the var as optimizer state for BuildStrategy.Reduce
+        # (ZeRO-style dp-sharding of accumulators, executor._mesh_shardings)
+        var.is_optimizer_state = True
         sb = framework.default_startup_program().global_block()
         sp = sb.create_var(name=var_name, shape=shape, dtype=dtype,
                            persistable=True)

@@ -1,0 +1,76 @@
+"""Predictor parity (ref ``analysis_predictor.cc:183,734``): train -> save
+-> load in a fresh scope -> identical outputs; warm cache on repeat calls."""
+
+import numpy as np
+
+import paddle_tpu as fluid
+from paddle_tpu.inference import (AnalysisConfig, Predictor,
+                                  create_paddle_predictor)
+
+
+def _train_and_save(tmp_path):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 9
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=16, act="relu")
+        h = fluid.layers.dropout(h, dropout_prob=0.4)  # must be off in infer
+        logits = fluid.layers.fc(h, size=3)
+        prob = fluid.layers.softmax(logits)
+        test_prog = main.clone(for_test=True)  # before minimize, as usual
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            exe.run(main, feed={"x": rng.randn(4, 8).astype("f4"),
+                                "y": rng.randint(0, 3, (4, 1))},
+                    fetch_list=[loss])
+        fluid.io.save_inference_model(str(tmp_path / "model"), ["x"],
+                                      [prob], exe, main_program=main)
+        # reference outputs from the training process's test clone
+        xs = np.linspace(-1, 1, 16).reshape(2, 8).astype("f4")
+        want, = exe.run(test_prog, feed={"x": xs}, fetch_list=[prob])
+    return xs, want
+
+
+def test_predictor_round_trip(tmp_path):
+    xs, want = _train_and_save(tmp_path)
+    cfg = AnalysisConfig(model_dir=str(tmp_path / "model"))
+    cfg.enable_memory_optim()
+    pred = create_paddle_predictor(cfg)
+    assert pred.get_input_names() == ["x"]
+    got, = pred.run({"x": xs})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    # positional form + repeat (warm cache) + determinism (dropout off)
+    got2, = pred.run([xs])
+    np.testing.assert_allclose(got2, want, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_shares_weights(tmp_path):
+    xs, want = _train_and_save(tmp_path)
+    pred = Predictor(str(tmp_path / "model"))
+    twin = pred.clone()
+    a, = pred.run({"x": xs})
+    b, = twin.run({"x": xs})
+    np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+
+def test_predictor_combined_file_config(tmp_path):
+    xs, want = _train_and_save(tmp_path)
+    import os
+    cfg = AnalysisConfig(
+        prog_file=str(tmp_path / "model" / "__model__"),
+        params_file=str(tmp_path / "model" / "params.npz"))
+    got, = Predictor(cfg).run({"x": xs})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    import pytest
+    with pytest.raises(ValueError, match="not inside"):
+        Predictor(AnalysisConfig(
+            model_dir=str(tmp_path),
+            prog_file=str(tmp_path / "model" / "__model__")))

@@ -284,3 +284,53 @@ def test_pipeline_transformer_smoke():
                   for _ in range(6)]
     assert np.isfinite(losses).all(), losses
     assert losses[-1] < losses[0], losses
+
+
+def test_zero_reduce_strategy_shards_optimizer_state():
+    """BuildStrategy.ReduceStrategy.Reduce = ZeRO-style: losses match
+    AllReduce mode and the Adam accumulators live dp-sharded on the mesh."""
+    mesh = _mesh((8,), ("dp",))
+    rng = np.random.RandomState(4)
+    xs = rng.randn(16, 16).astype("float32")
+    ys = rng.randn(16, 1).astype("float32")
+
+    def run(reduce_mode):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 17
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            fluid.unique_name.switch()
+            x = fluid.layers.data("x", shape=[16])
+            y = fluid.layers.data("y", shape=[1])
+            h = fluid.layers.fc(x, size=32, act="tanh")
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(h, size=1), y))
+            opt = fluid.optimizer.Adam(learning_rate=0.01)
+            opt.minimize(loss)
+            bs = fluid.BuildStrategy()
+            if reduce_mode:
+                bs.reduce_strategy = fluid.BuildStrategy.ReduceStrategy.Reduce
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            prog = fluid.CompiledProgram(main).with_data_parallel(
+                loss_name=loss.name, mesh=mesh, build_strategy=bs)
+            losses = [float(exe.run(prog, feed={"x": xs, "y": ys},
+                                    fetch_list=[loss])[0])
+                      for _ in range(4)]
+            # moment accumulator for the [16,32] fc weight
+            acc_name = next(
+                v.name for n, d in opt._accumulators.items()
+                for v in d.values()
+                if n == "moment1" and tuple(v.shape) == (16, 32))
+            acc = scope.get(acc_name)
+        return losses, acc
+
+    ref_losses, acc_all = run(reduce_mode=False)
+    z_losses, acc_zero = run(reduce_mode=True)
+    np.testing.assert_allclose(z_losses, ref_losses, rtol=1e-5, atol=1e-7)
+    # state parity AND dp-sharded residency in Reduce mode
+    np.testing.assert_allclose(np.asarray(acc_zero), np.asarray(acc_all),
+                               rtol=1e-5, atol=1e-8)
+    from jax.sharding import PartitionSpec as P
+    assert acc_all.sharding.is_fully_replicated
+    assert acc_zero.sharding.spec == P("dp", None)
