@@ -42,6 +42,8 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import inference  # noqa: F401
+from .async_executor import AsyncExecutor  # noqa: F401
+from .data.data_feed import DataFeedDesc  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import data  # noqa: F401
 from .data.feeder import DataFeeder  # noqa: F401

@@ -1,0 +1,72 @@
+"""AsyncExecutor: file-fed training driven by the native data plane.
+
+Reference: ``paddle/fluid/framework/async_executor.h:64`` — worker threads
+each own a DataFeed over a file split and run the program op-by-op;
+``python/paddle/fluid/async_executor.py`` is the Python driver.
+
+TPU-native re-design: the parallelism moves to the right places for one
+big accelerator — C++ reader threads (``native/prefetch_queue.cc``) keep an
+MPMC byte-record queue full from recordio files, the host assembles dense
+batches (one np.frombuffer per slot, ``data/data_feed.py``), and ONE
+compiled step function consumes them back-to-back (dispatch is async, so
+host batching overlaps device compute). Thread-per-graph execution would
+only fragment the TPU; thread_num instead scales the file readers.
+"""
+
+import numpy as np
+
+from . import native
+from .core import framework
+from .core.executor import Executor, global_scope
+
+__all__ = ["AsyncExecutor"]
+
+
+class AsyncExecutor:
+    def __init__(self, place=None, run_mode=""):
+        self.place = place
+        self._exe = Executor(place)
+
+    def run(self, program, data_feed, filelist, thread_num=2,
+            fetch=None, mode="", debug=False, n_epochs=1, scope=None,
+            queue_capacity=1024):
+        """Train ``program`` over every sample in ``filelist`` (recordio
+        files of ``data_feed``-serialized samples). Returns the list of
+        fetch values from the last step.
+
+        ``thread_num`` = native reader threads (ref: worker thread count).
+        Partial final batches are dropped, matching the fixed-shape batch
+        convention (and the reference's DataFeed batch semantics)."""
+        program = program or framework.default_main_program()
+        fetch = fetch or []
+        if isinstance(filelist, str):
+            filelist = [filelist]
+        if not native.native_available():
+            raise RuntimeError("AsyncExecutor needs the native data plane "
+                               "(g++ toolchain) — use PyReader instead")
+        scope = scope or global_scope()
+        fetch_vals = None
+        bs = data_feed.batch_size
+        steps = 0
+        with native.PrefetchQueue(capacity=queue_capacity) as q:
+            q.start_files(list(filelist), n_threads=int(thread_num),
+                          n_epochs=int(n_epochs))
+            batch = []
+            for rec in q:
+                batch.append(rec)
+                if len(batch) < bs:
+                    continue
+                feed = data_feed.parse_batch(batch)
+                batch = []
+                fetch_vals = self._exe.run(program, feed=feed,
+                                           fetch_list=fetch, scope=scope,
+                                           return_numpy=False)
+                steps += 1
+                if debug and steps % 100 == 0:
+                    print("AsyncExecutor: %d steps" % steps)
+        if fetch_vals is None:
+            raise RuntimeError(
+                "AsyncExecutor: no full batch assembled from %d file(s) — "
+                "fewer than batch_size=%d records present (partial batches "
+                "are dropped)" % (len(filelist), bs))
+        return [np.asarray(v) for v in fetch_vals]

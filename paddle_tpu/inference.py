@@ -14,8 +14,6 @@ program cache), and zero-copy device-resident feeds (jax.Array passthrough).
 
 import os
 
-import numpy as np
-
 from . import io as io_mod
 from .core.executor import Executor, Scope, scope_guard, XLAPlace
 
@@ -108,10 +106,13 @@ class Predictor:
             missing = set(self.feed_names) - set(feed)
             if missing:
                 raise ValueError("missing feeds: %s" % sorted(missing))
-        with scope_guard(self._scope):
-            return self._exe.run(self._program, feed=feed,
-                                 fetch_list=self._fetch_vars,
-                                 return_numpy=return_numpy)
+        # scope passed explicitly (not via the global scope_guard stack):
+        # clones serving concurrently from other threads must not race on
+        # process-global scope resolution
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars,
+                             scope=self._scope,
+                             return_numpy=return_numpy)
 
     predict = run
 
