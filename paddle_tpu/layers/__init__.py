@@ -3,6 +3,7 @@
 from . import nn
 from . import ops
 from . import tensor
+from . import detection
 from . import io
 from . import control_flow
 from . import metric_op
@@ -16,6 +17,7 @@ from .tensor import *  # noqa: F401,F403
 from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
 from .control_flow import (StaticRNN, While, Switch, cond,  # noqa: F401
                            array_write, array_read, create_array,
                            array_length, IfElse, less_than, equal,

@@ -1,0 +1,92 @@
+"""QAT transpiler + slim (ref ``contrib/quantize/quantize_transpiler.py``,
+``contrib/slim/``): fake-quant insertion, QAT training, freeze/int8
+export, magnitude pruning, distillation loss."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.contrib.quantize import QuantizeTranspiler
+from paddle_tpu.contrib.slim import Pruner, soft_label_loss
+
+
+def _net():
+    img = fluid.layers.data("img", shape=[1, 8, 8])
+    label = fluid.layers.data("label", shape=[1], dtype="int64")
+    x = fluid.layers.conv2d(img, num_filters=4, filter_size=3, act="relu",
+                            name="qconv")
+    x = fluid.layers.pool2d(x, pool_size=2, pool_stride=2)
+    logits = fluid.layers.fc(x, size=3, name="qfc")
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    return logits, loss
+
+
+def test_qat_train_freeze_int8():
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 31
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        logits, loss = _net()
+        qt = QuantizeTranspiler()
+        qt.training_transpile(main, startup)
+        types = [op.type for op in main.global_block().ops]
+        assert types.count("fake_channel_wise_quantize_abs_max") == 2
+        assert types.count("fake_quantize_moving_average_abs_max") == 2
+        fluid.optimizer.Adam(learning_rate=5e-3).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        xs = rng.randn(16, 1, 8, 8).astype("f4")
+        ys = rng.randint(0, 3, (16, 1)).astype("int64")
+        losses = [float(exe.run(main, feed={"img": xs, "label": ys},
+                                fetch_list=[loss])[0]) for _ in range(15)]
+        assert losses[-1] < losses[0] and np.isfinite(losses).all()
+
+        # freeze: weights land on the int8 grid, weight quant ops vanish
+        infer = main.clone(for_test=True)
+        infer = infer.prune([infer.global_block().var(logits.name)])
+        qt.freeze_program(infer, scope=scope)
+        itypes = [op.type for op in infer.global_block().ops]
+        assert "fake_channel_wise_quantize_abs_max" not in itypes
+        w = np.asarray(scope.get("qconv.w_0_0"))
+        # per-out-channel: values/scale*qmax must be (close to) integers
+        scale = np.max(np.abs(w), axis=(1, 2, 3), keepdims=True)
+        grid = w / np.maximum(scale, 1e-8) * 127.0
+        np.testing.assert_allclose(grid, np.round(grid), atol=1e-3)
+        out1, = exe.run(infer, feed={"img": xs}, fetch_list=[logits])
+        assert np.isfinite(out1).all()
+
+        # int8 export round-trips within one quantization step
+        bundle = qt.convert_to_int8(main, scope=scope)
+        i8, scales = bundle["qconv.w_0_0"]
+        assert i8.dtype == np.int8
+        deq = i8.astype("f4") * scales.reshape(-1, 1, 1, 1) / 127.0
+        np.testing.assert_allclose(deq, w, atol=np.max(scales) / 127.0 + 1e-6)
+
+
+def test_pruner_and_distill_loss():
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 3
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", shape=[16])
+        s_logits = fluid.layers.fc(x, size=4, name="student")
+        t_logits = fluid.layers.fc(x, size=4, name="teacher")
+        dloss = soft_label_loss(s_logits, t_logits, temperature=3.0)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        v, = exe.run(main, feed={"x": np.random.RandomState(0)
+                                 .randn(4, 16).astype("f4")},
+                     fetch_list=[dloss])
+        assert np.isfinite(v).all() and float(v) > 0
+
+        w_name = "student.w_0_0"
+        before = np.asarray(scope.get(w_name))
+        masks = Pruner({w_name: 0.5}).prune(scope)
+        after = np.asarray(scope.get(w_name))
+        frac = float((after == 0).mean())
+        assert 0.4 <= frac <= 0.6, frac
+        assert masks[w_name].shape == before.shape
