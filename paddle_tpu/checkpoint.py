@@ -23,13 +23,15 @@ import os
 import shutil
 import tempfile
 import threading
+import warnings
 
 import numpy as np
 
 from .core import framework
 from .core.executor import global_scope
 
-__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter"]
+__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter",
+           "resume_or_init", "AutoCheckpoint"]
 
 _MANIFEST = "checkpoint_manifest.json"
 
@@ -272,8 +274,15 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
         if name not in persist:
             continue
         if meta["kind"] == "replicated":
-            if name in repl:
-                scope.set(name, jnp.asarray(repl[name]))
+            if name not in repl:
+                # the manifest promised this var: a missing/torn
+                # replicated.npz must fail the load (the resume fallback
+                # then tries the previous version) rather than silently
+                # keeping startup-initialized weights
+                raise IOError(
+                    "checkpoint %s: replicated var %r missing from "
+                    "replicated.npz (torn save?)" % (vdir, name))
+            scope.set(name, jnp.asarray(repl[name]))
             continue
         full = np.zeros(tuple(meta["shape"]), dtype=meta["dtype"])
         # boolean coverage mask: piece indices may overlap across processes
@@ -323,3 +332,118 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
 
         scope.set(RNG_KEY, key)
     return manifest.get("extra", {})
+
+
+# ---------------------------------------------------------------------------
+# elastic / preemption recovery (SURVEY §5.3)
+# ---------------------------------------------------------------------------
+# The reference's failure story is pserver checkpoint_notify + external
+# restart; on TPU pods the analog is preemption-safe training: every
+# process restart lands in resume_or_init, which either cold-starts or
+# restores the newest complete checkpoint, and AutoCheckpoint keeps one
+# being written in the background at a step/time cadence.
+
+
+def resume_or_init(executor, startup_program, checkpoint_dir,
+                   main_program=None, scope=None):
+    """Run the startup program, then overwrite with the newest checkpoint
+    when one exists. Returns the checkpoint's ``extra`` metadata, or None
+    on a cold start — the preemption-safe entry point: unconditionally
+    call this first, loop from ``extra['step']``."""
+    executor.run(startup_program, scope=scope)
+    if not os.path.isdir(checkpoint_dir):
+        return None
+    versions = sorted(
+        (int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)
+         if d.startswith("checkpoint_") and d.split("_")[1].isdigit()),
+        reverse=True)
+    if not versions:
+        return None
+    # a preemption can land mid-save (e.g. one process's shard file never
+    # written): fall back through older complete checkpoints instead of
+    # crashing every restart on the torn newest one
+    last_err = None
+    for v in versions:
+        try:
+            return load_checkpoint(executor, checkpoint_dir,
+                                   main_program=main_program, scope=scope,
+                                   version=v)
+        except (IOError, OSError, KeyError, ValueError) as e:
+            warnings.warn("checkpoint_%d is unusable (%s); trying the "
+                          "previous version" % (v, e))
+            last_err = e
+    raise last_err
+
+
+class AutoCheckpoint:
+    """Background-cadence checkpointing for a training loop:
+
+        ac = AutoCheckpoint(exe, ckpt_dir, main_program=prog,
+                            every_steps=100)
+        for step in range(start, n):
+            ...train...
+            ac.step({"step": step + 1})
+        ac.close()
+
+    Writes are async (the previous write is joined by the next save /
+    close). ``every_seconds`` uses a wall-clock cadence instead."""
+
+    def __init__(self, executor, checkpoint_dir, main_program=None,
+                 scope=None, every_steps=None, every_seconds=None,
+                 max_num_checkpoints=3):
+        if not every_steps and not every_seconds:
+            every_steps = 1000
+        if every_seconds and _process_index()[1] > 1:
+            # wall-clock cadences desynchronize across processes: each
+            # process would claim a different version dir at a different
+            # step, leaving no restorable checkpoint at all
+            raise ValueError(
+                "AutoCheckpoint(every_seconds=...) is per-process "
+                "wall-clock and unsafe in multi-process training; use "
+                "every_steps (deterministic across processes)")
+        self.executor = executor
+        self.checkpoint_dir = checkpoint_dir
+        self.main_program = main_program
+        self.scope = scope
+        self.every_steps = every_steps
+        self.every_seconds = every_seconds
+        self.max_num = max_num_checkpoints
+        self._count = 0
+        self._last_time = _now()
+        self._writer = None
+
+    def step(self, extra_meta=None, force=False):
+        """Call once per training step; saves when the cadence is due.
+        Returns the CheckpointWriter when a save started, else None."""
+        self._count += 1
+        due = force
+        if self.every_steps and self._count % self.every_steps == 0:
+            due = True
+        if self.every_seconds and (_now() - self._last_time
+                                   >= self.every_seconds):
+            due = True
+        if not due:
+            return None
+        # surface any failure of the previous cadenced write NOW — silently
+        # replacing a failed writer would let training run to completion
+        # believing checkpoints exist
+        if self._writer is not None:
+            self._writer.wait()
+        self._last_time = _now()
+        self._writer = save_checkpoint(
+            self.executor, self.checkpoint_dir,
+            main_program=self.main_program, scope=self.scope,
+            max_num_checkpoints=self.max_num, async_write=True,
+            extra_meta=extra_meta)
+        return self._writer
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.wait()
+            self._writer = None
+
+
+def _now():
+    import time
+
+    return time.monotonic()

@@ -9,17 +9,66 @@ import os
 import sys
 
 
+def build_model(model, fluid, models):
+    """Build (spec, batch16) for a named test model. Shared between the
+    multi-process runner and the single-process comparator so both sides
+    train the identical program."""
+    import numpy as np
+
+    if model == "mlp":
+        spec = models.mnist.mlp(hidden_sizes=(32,))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(spec.loss)
+        return spec, spec.sample_batch(16, np.random.RandomState(77))
+    if model == "transformer":
+        spec = models.transformer.transformer_base(
+            src_vocab=64, trg_vocab=64, seq_len=8, d_model=16, d_ff=32,
+            n_head=2, n_layer=2, dropout_rate=0.0)
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(spec.loss)
+        return spec, spec.sample_batch(16, np.random.RandomState(78))
+    if model == "sharded_emb":
+        x = fluid.layers.data("ids", shape=[6], dtype="int64")
+        y = fluid.layers.data("y", shape=[1])
+        # row-sharded over mp — the annotation DistributeTranspiler sets
+        # for is_distributed tables (parallel/transpiler.py:57)
+        emb = fluid.layers.embedding(
+            x, size=[64, 8], is_distributed=True,
+            param_attr=fluid.ParamAttr(name="dist_table",
+                                       sharding=("mp", None)))
+        h = fluid.layers.reduce_sum(emb, dim=1)
+        pred = fluid.layers.fc(h, size=1)
+        loss = fluid.layers.mean(fluid.layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+        from paddle_tpu.models.common import FeedSpec, ModelSpec
+        spec = ModelSpec(loss, feeds={
+            "ids": FeedSpec([6], "int64", 0, 64),
+            "y": FeedSpec([1], "float32")})
+        return spec, spec.sample_batch(16, np.random.RandomState(79))
+    raise SystemExit("unknown model %r" % model)
+
+
+def make_mesh(model, jax, nproc):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices())
+    if model == "mlp":
+        return Mesh(devs, ("dp",))
+    # dp across processes, mp within each process's local devices
+    per = len(devs) // nproc
+    return Mesh(devs.reshape(nproc, per), ("dp", "mp"))
+
+
 def main():
     pid = int(sys.argv[1])
     nproc = int(sys.argv[2])
     port = sys.argv[3]
     steps = int(sys.argv[4])
+    model = sys.argv[5] if len(sys.argv) > 5 else "mlp"
 
     import jax
     jax.distributed.initialize("127.0.0.1:%s" % port, num_processes=nproc,
                                process_id=pid)
     import numpy as np
-    from jax.sharding import Mesh
 
     sys.path.insert(0, os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
@@ -29,17 +78,15 @@ def main():
     main_p, startup = fluid.Program(), fluid.Program()
     main_p.random_seed = startup.random_seed = 1234
     with fluid.program_guard(main_p, startup):
-        spec = models.mnist.mlp(hidden_sizes=(32,))
-        fluid.optimizer.SGD(learning_rate=0.1).minimize(spec.loss)
+        spec, global_batch = build_model(model, fluid, models)
 
-    mesh = Mesh(np.array(jax.devices()), ("dp",))
+    mesh = make_mesh(model, jax, nproc)
     exe = fluid.Executor(fluid.XLAPlace(0))
     scope = fluid.Scope()
     with fluid.scope_guard(scope):
         exe.run(startup)
         cp = fluid.CompiledProgram(main_p).with_data_parallel(
             loss_name=spec.loss.name, mesh=mesh)
-        global_batch = spec.sample_batch(16, np.random.RandomState(77))
         per = 16 // nproc
         local = {k: v[pid * per:(pid + 1) * per]
                  for k, v in global_batch.items()}
@@ -47,6 +94,10 @@ def main():
         for _ in range(steps):
             lv, = exe.run(cp, feed=local, fetch_list=[spec.loss])
             losses.append(float(np.asarray(lv)))
+        if model == "sharded_emb":
+            spec_ = scope.get("dist_table").sharding.spec
+            print("TABLE_SPEC " + json.dumps(list(map(str, spec_))),
+                  flush=True)
     print("DIST_LOSSES " + json.dumps(losses), flush=True)
 
 

@@ -1,0 +1,96 @@
+"""Launcher (ref ``distributed/launch.py``) + elastic recovery (SURVEY
+§5.3): env protocol, fate-sharing, resume_or_init / AutoCheckpoint."""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.launch import launch
+
+
+def test_launch_env_protocol_and_logs(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        print("id=%s n=%s ep=%s" % (
+            os.environ["PADDLE_TRAINER_ID"],
+            os.environ["PADDLE_TRAINERS_NUM"],
+            os.environ["PADDLE_CURRENT_ENDPOINT"]))
+    """))
+    rc = launch(["--nproc_per_node=2", "--log_dir", str(tmp_path / "logs"),
+                 str(script)])
+    assert rc == 0
+    logs = sorted(os.listdir(tmp_path / "logs"))
+    assert logs == ["workerlog.0", "workerlog.1"]
+    l0 = (tmp_path / "logs" / "workerlog.0").read_text()
+    assert "id=0 n=2" in l0 and ":6170" in l0
+    l1 = (tmp_path / "logs" / "workerlog.1").read_text()
+    assert "id=1 n=2" in l1 and ":6171" in l1
+
+
+def test_launch_fate_sharing(tmp_path):
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        if os.environ["PADDLE_TRAINER_ID"] == "1":
+            sys.exit(7)
+        time.sleep(60)  # must be terminated by the launcher
+    """))
+    import time
+    t0 = time.time()
+    rc = launch(["--nproc_per_node=2", str(script)])
+    assert rc == 7
+    assert time.time() - t0 < 30  # worker 0 was torn down, not waited out
+
+
+def test_elastic_resume(tmp_path):
+    """Preemption drill: train with AutoCheckpoint, 'crash' (fresh program
+    + scope), resume_or_init, and the continued loss stream matches an
+    uninterrupted run."""
+    ckpt = str(tmp_path / "c")
+    rng = np.random.RandomState(0)
+    xs = rng.randn(8, 8).astype("f4")
+    ys = rng.randn(8, 1).astype("f4")
+
+    def session(n_steps, start_expected, preempt_at=None):
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 19
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            x = fluid.layers.data("x", shape=[8])
+            y = fluid.layers.data("y", shape=[1])
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(x, size=1), y))
+            fluid.optimizer.Adam(0.05).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            extra = fluid.checkpoint.resume_or_init(exe, startup, ckpt,
+                                                    main_program=main)
+            start = (extra or {}).get("step", 0)
+            assert start == start_expected, (start, start_expected)
+            ac = fluid.checkpoint.AutoCheckpoint(exe, ckpt,
+                                                 main_program=main,
+                                                 every_steps=1)
+            out = []
+            for s in range(start, n_steps):
+                lv, = exe.run(main, feed={"x": xs, "y": ys},
+                              fetch_list=[loss])
+                out.append(float(lv))
+                ac.step({"step": s + 1})
+                if preempt_at is not None and s + 1 == preempt_at:
+                    ac.close()
+                    return out  # simulated kill AFTER ckpt lands
+            ac.close()
+        return out
+
+    first = session(6, 0, preempt_at=3)
+    resumed = session(6, 3)
+
+    import shutil
+    shutil.rmtree(ckpt)
+    full = session(6, 0)
+    np.testing.assert_allclose(first + resumed, full, rtol=1e-6)
