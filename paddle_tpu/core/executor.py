@@ -19,6 +19,7 @@ Randomness is a threaded functional PRNG key stored in the scope under
 ``@RNG@`` (vs. the reference's per-device curand states).
 """
 
+import os
 import warnings
 
 import jax
@@ -207,11 +208,23 @@ class Executor:
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, feed_var_name="feed",
-            fetch_var_name="fetch"):
+            fetch_var_name="fetch", check_nan_inf=None):
         from .compiler import CompiledProgram
 
         if program is None:
             program = framework.default_main_program()
+        if check_nan_inf is None:
+            flag = os.environ.get("FLAGS_check_nan_inf", "").strip().lower()
+            check_nan_inf = flag in ("1", "true", "yes", "on")
+        if check_nan_inf:
+            if isinstance(program, CompiledProgram):
+                warnings.warn("check_nan_inf runs op-by-op and only "
+                              "supports plain Programs; the CompiledProgram "
+                              "runs unchecked on the jit path")
+            else:
+                return self._run_checked(program, feed or {},
+                                         fetch_list or [], scope,
+                                         return_numpy)
         mesh = None
         dp_axis = None
         sp_axis = None
@@ -325,6 +338,70 @@ class Executor:
         """Parity with ``Executor::Close`` (``executor.cc:139``): release the
         compiled-program cache."""
         self._cache.clear()
+
+    # -- debug run-mode -----------------------------------------------------
+    def _run_checked(self, program, feed, fetch_list, scope, return_numpy):
+        """FLAGS_check_nan_inf parity (ref ``operators/isfinite_op.cc`` +
+        the framework's CheckOpHasNanOrInf debug hook): run the program
+        op-by-op WITHOUT jit, checking every float output after each op and
+        raising with the op type + var name of the first bad value. Slow by
+        design — a debugging mode."""
+        from .op_registry import AMP
+
+        if scope is None:
+            scope = global_scope()
+        fetch_names = [v.name if isinstance(v, Variable) else str(v)
+                       for v in fetch_list]
+        gb = program.global_block()
+        env = {}
+        persist_names = sorted({v.name for v in program.list_vars()
+                                if v.persistable})
+        for n in persist_names:
+            if n in scope:
+                env[n] = scope.get(n)
+        for name, value in feed.items():
+            var = gb.var(name) if gb.has_var(name) else None
+            env[name] = jnp.asarray(_as_array(value, var))
+        if RNG_KEY not in scope:
+            if program.random_seed:
+                seed = program.random_seed
+            else:  # random_seed=0 = nondeterministic, same as run()
+                import secrets
+                seed = secrets.randbits(31)
+            scope.set(RNG_KEY, _make_rng_key(seed))
+        env[RNG_KEY] = scope.get(RNG_KEY)
+        env[RNG0_KEY] = env[RNG_KEY]
+        env[ENV0_KEY] = dict(env)
+        prev_amp = AMP.enabled
+        AMP.enabled = bool(getattr(program, "_amp_bf16", False))
+        try:
+            for op in gb.ops:
+                before = {n: env.get(n) for n in op.output_arg_names}
+                run_op(env, op)
+                for n in op.output_arg_names:
+                    v = env.get(n)
+                    if v is None or v is before.get(n):
+                        continue
+                    if not (hasattr(v, "dtype")
+                            and jnp.issubdtype(v.dtype, jnp.floating)):
+                        continue
+                    # bf16 numpy views have dtype.kind 'V'; upcast so the
+                    # AMP overflows this flag exists to catch are seen
+                    arr = np.asarray(jnp.asarray(v).astype(jnp.float32))
+                    if not np.isfinite(arr).all():
+                        bad = "nan" if np.isnan(arr).any() else "inf"
+                        raise RuntimeError(
+                            "check_nan_inf: op '%s' produced %s in output "
+                            "var '%s' (shape %s)"
+                            % (op.type, bad, n, arr.shape))
+        finally:
+            AMP.enabled = prev_amp
+        scope.set(RNG_KEY, env[RNG_KEY])
+        for n in persist_names:
+            if n in env:
+                scope.set(n, env[n])
+        out = [env[n] for n in fetch_names]
+        return [np.asarray(o) for o in out] if return_numpy else out
 
     # -- compilation --------------------------------------------------------
     def _mesh_shardings(self, program, feed_names, fetch_names,

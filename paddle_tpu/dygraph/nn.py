@@ -8,11 +8,11 @@ import jax.numpy as jnp
 
 from ..core.initializer import (ConstantInitializer, NormalInitializer,
                                 XavierInitializer)
-from .base import VarBase, to_variable
+from .base import VarBase, record, to_variable
 from .layers import Layer
 
 __all__ = ["FC", "Linear", "Conv2D", "Pool2D", "BatchNorm", "Embedding",
-           "LayerNorm", "Dropout", "PRelu"]
+           "LayerNorm", "Dropout", "PRelu", "GRUUnit"]
 
 
 def _v(x):
@@ -36,21 +36,23 @@ class FC(Layer):
         self._b = self.create_parameter([self._size], is_bias=True)
 
     def forward(self, x):
-        xv = _v(x)
-        lead = xv.shape[: self._num_flatten_dims]
-        xv2 = xv.reshape(int(jnp.prod(jnp.asarray(lead))) if lead else 1, -1) \
-            if xv.ndim != 2 else xv
         import numpy as np
-        xv2 = xv.reshape(int(np.prod(lead)), -1)
+
+        x = to_variable(x)
+        flat_in = int(np.prod(x.shape[self._num_flatten_dims:]))
         if self._w is None:
-            self._build_once(xv2.shape[-1])
-        out = xv2 @ self._w.value() + self._b.value()
-        out = out.reshape(tuple(lead) + (self._size,))
-        if self._act:
-            out = getattr(jax.nn, self._act if self._act != "relu6"
-                          else "relu6")(out) if hasattr(jax.nn, self._act) \
-                else getattr(jnp, self._act)(out)
-        return VarBase(out)
+            self._build_once(flat_in)
+        act, size, nfd = self._act, self._size, self._num_flatten_dims
+
+        def fn(xv, w, b):
+            xv2 = xv.reshape(int(np.prod(xv.shape[:nfd])), -1)
+            out = (xv2 @ w + b).reshape(tuple(xv.shape[:nfd]) + (size,))
+            if act:
+                out = getattr(jax.nn, act)(out) if hasattr(jax.nn, act) \
+                    else getattr(jnp, act)(out)
+            return out
+
+        return record(fn, x, self._w, self._b)
 
 
 Linear = FC
@@ -74,18 +76,19 @@ class Conv2D(Layer):
         self._bias = self.create_parameter([num_filters], is_bias=True)
 
     def forward(self, x):
-        xv = _v(x)
-        out = jax.lax.conv_general_dilated(
-            xv, self._filter.value(), window_strides=tuple(self._stride),
-            padding=[(self._padding[0], self._padding[0]),
-                     (self._padding[1], self._padding[1])],
-            rhs_dilation=tuple(self._dilation),
-            feature_group_count=self._groups,
-            dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        out = out + self._bias.value().reshape(1, -1, 1, 1)
-        if self._act == "relu":
-            out = jax.nn.relu(out)
-        return VarBase(out)
+        stride, pad, dil = self._stride, self._padding, self._dilation
+        groups, act = self._groups, self._act
+
+        def fn(xv, w, b):
+            out = jax.lax.conv_general_dilated(
+                xv, w, window_strides=tuple(stride),
+                padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                rhs_dilation=tuple(dil), feature_group_count=groups,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            out = out + b.reshape(1, -1, 1, 1)
+            return jax.nn.relu(out) if act == "relu" else out
+
+        return record(fn, to_variable(x), self._filter, self._bias)
 
 
 class Pool2D(Layer):
@@ -100,23 +103,24 @@ class Pool2D(Layer):
         self._global = global_pooling
 
     def forward(self, x):
-        xv = _v(x)
-        if self._global:
-            red = jnp.max if self._type == "max" else jnp.mean
-            return VarBase(red(xv, axis=(2, 3), keepdims=True))
-        window = (1, 1) + tuple(self._size)
-        stride = (1, 1) + tuple(self._stride)
-        pads = [(0, 0), (0, 0),
-                (self._padding[0], self._padding[0]),
-                (self._padding[1], self._padding[1])]
-        if self._type == "max":
-            out = jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max, window,
-                                        stride, pads)
-        else:
-            s = jax.lax.reduce_window(xv, 0.0, jax.lax.add, window, stride,
-                                      pads)
-            out = s / (self._size[0] * self._size[1])
-        return VarBase(out)
+        size, stride_, pad = self._size, self._stride, self._padding
+        gpool, ptype = self._global, self._type
+
+        def fn(xv):
+            if gpool:
+                red = jnp.max if ptype == "max" else jnp.mean
+                return red(xv, axis=(2, 3), keepdims=True)
+            window = (1, 1) + tuple(size)
+            stride = (1, 1) + tuple(stride_)
+            pads = [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])]
+            if ptype == "max":
+                return jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max,
+                                             window, stride, pads)
+            sm = jax.lax.reduce_window(xv, 0.0, jax.lax.add, window,
+                                       stride, pads)
+            return sm / (size[0] * size[1])
+
+        return record(fn, to_variable(x))
 
 
 class BatchNorm(Layer):
@@ -136,8 +140,10 @@ class BatchNorm(Layer):
         self._act = act
 
     def forward(self, x):
-        xv = _v(x)
+        x = to_variable(x)
+        xv = x.value()
         cshape = (1, -1) + (1,) * (xv.ndim - 2)
+        eps, act = self._eps, self._act
         if self.training:
             axes = tuple(i for i in range(xv.ndim) if i != 1)
             mu = jnp.mean(xv, axis=axes)
@@ -146,15 +152,25 @@ class BatchNorm(Layer):
                                  + (1 - self._momentum) * jax.lax.stop_gradient(mu))
             self._var._value = (self._momentum * self._var.value()
                                 + (1 - self._momentum) * jax.lax.stop_gradient(var))
-        else:
-            mu, var = self._mean.value(), self._var.value()
-        out = (xv - mu.reshape(cshape)) * jax.lax.rsqrt(
-            var.reshape(cshape) + self._eps)
-        out = out * self._scale.value().reshape(cshape) \
-            + self._bias.value().reshape(cshape)
-        if self._act == "relu":
-            out = jax.nn.relu(out)
-        return VarBase(out)
+
+            def fn(xv_, scale, bias):
+                m = jnp.mean(xv_, axis=axes)
+                v = jnp.var(xv_, axis=axes)
+                out = (xv_ - m.reshape(cshape)) * jax.lax.rsqrt(
+                    v.reshape(cshape) + eps)
+                out = out * scale.reshape(cshape) + bias.reshape(cshape)
+                return jax.nn.relu(out) if act == "relu" else out
+
+            return record(fn, x, self._scale, self._bias)
+
+        def fn(xv_, scale, bias, mu, var):
+            out = (xv_ - mu.reshape(cshape)) * jax.lax.rsqrt(
+                var.reshape(cshape) + eps)
+            out = out * scale.reshape(cshape) + bias.reshape(cshape)
+            return jax.nn.relu(out) if act == "relu" else out
+
+        return record(fn, x, self._scale, self._bias,
+                      self._mean.value(), self._var.value())
 
 
 class LayerNorm(Layer):
@@ -170,13 +186,16 @@ class LayerNorm(Layer):
         self._eps = epsilon
 
     def forward(self, x):
-        xv = _v(x)
-        axes = tuple(range(xv.ndim - len(self._shape), xv.ndim))
-        mu = jnp.mean(xv, axis=axes, keepdims=True)
-        var = jnp.var(xv, axis=axes, keepdims=True)
-        out = (xv - mu) * jax.lax.rsqrt(var + self._eps)
-        out = out * self._scale.value() + self._bias.value()
-        return VarBase(out)
+        x = to_variable(x)
+        nshape, eps = len(self._shape), self._eps
+
+        def fn(xv, scale, bias):
+            axes = tuple(range(xv.ndim - nshape, xv.ndim))
+            mu = jnp.mean(xv, axis=axes, keepdims=True)
+            var = jnp.var(xv, axis=axes, keepdims=True)
+            return (xv - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+        return record(fn, x, self._scale, self._bias)
 
 
 class Embedding(Layer):
@@ -189,13 +208,21 @@ class Embedding(Layer):
             list(size), initializer=XavierInitializer())
 
     def forward(self, ids):
-        iv = _v(ids).astype(jnp.int32)
-        if iv.ndim >= 2 and iv.shape[-1] == 1:
-            iv = iv.squeeze(-1)
-        out = jnp.take(self._w.value(), iv, axis=0)
-        if self._padding_idx is not None:
-            out = out * (iv != self._padding_idx)[..., None].astype(out.dtype)
-        return VarBase(out)
+        pad_idx = self._padding_idx
+
+        def fn(iv, w):
+            iv = iv.astype(jnp.int32)
+            if iv.ndim >= 2 and iv.shape[-1] == 1:
+                iv = iv.squeeze(-1)
+            out = jnp.take(w, iv, axis=0)
+            if pad_idx is not None:
+                out = out * (iv != pad_idx)[..., None].astype(out.dtype)
+            return out
+
+        # integer ids carry no gradient; mark a LOCAL copy, never the
+        # caller's VarBase
+        ids = VarBase(to_variable(ids).value(), stop_gradient=True)
+        return record(fn, ids, self._w)
 
 
 class Dropout(Layer):
@@ -206,12 +233,17 @@ class Dropout(Layer):
         self._p = p
 
     def forward(self, x):
-        xv = _v(x)
+        x = to_variable(x)
         if not self.training or self._p == 0.0:
-            return VarBase(xv)
+            return x
         Dropout._key, sub = jax.random.split(Dropout._key)
-        keep = jax.random.bernoulli(sub, 1.0 - self._p, xv.shape)
-        return VarBase(xv * keep / (1.0 - self._p))
+        p = self._p
+
+        def fn(xv):
+            keep = jax.random.bernoulli(sub, 1.0 - p, xv.shape)
+            return xv * keep / (1.0 - p)
+
+        return record(fn, x)
 
 
 class PRelu(Layer):
@@ -221,6 +253,57 @@ class PRelu(Layer):
             [1], initializer=ConstantInitializer(0.25))
 
     def forward(self, x):
-        xv = _v(x)
-        a = self._alpha.value()
-        return VarBase(jnp.where(xv > 0, xv, a * xv))
+        return record(lambda xv, a: jnp.where(xv > 0, xv, a * xv),
+                      to_variable(x), self._alpha)
+
+
+class GRUUnit(Layer):
+    """Single-step GRU cell (ref ``imperative/nn.py`` GRUUnit wrapping
+    ``gru_unit_op``): gates from [x_t | h_{t-1}]."""
+
+    def __init__(self, name_scope=None, size=None, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        # size is 3*hidden (the reference convention)
+        self._hidden = size // 3
+        self._gate_w = None
+        self._cand_w = None
+
+    def _build_once(self, input_dim):
+        h = self._hidden
+        self._gate_w = self.create_parameter([input_dim + h, 2 * h])
+        self._gate_b = self.create_parameter([2 * h], is_bias=True)
+        self._cand_w = self.create_parameter([input_dim + h, h])
+        self._cand_b = self.create_parameter([h], is_bias=True)
+
+    def forward(self, x, hidden):
+        x = to_variable(x)
+        hidden = to_variable(hidden)
+        if self._gate_w is None:
+            self._build_once(x.shape[-1])
+        h = self._hidden
+
+        def fn(xv, hv, gw, gb, cw, cb):
+            cat = jnp.concatenate([xv, hv], axis=-1)
+            gates = jax.nn.sigmoid(cat @ gw + gb)
+            u, r = gates[..., :h], gates[..., h:]
+            cat_r = jnp.concatenate([xv, r * hv], axis=-1)
+            c = jnp.tanh(cat_r @ cw + cb)
+            return u * hv + (1.0 - u) * c
+
+        out = record(fn, x, hidden, self._gate_w, self._gate_b,
+                     self._cand_w, self._cand_b)
+
+        # reference GRUUnit returns (updated_hidden, reset_hidden_pre,
+        # gate); recompute the aux outputs as their own taped nodes
+        def fn_reset(xv, hv, gw, gb):
+            gates = jax.nn.sigmoid(
+                jnp.concatenate([xv, hv], axis=-1) @ gw + gb)
+            return gates[..., h:] * hv
+
+        def fn_gate(xv, hv, gw, gb):
+            return jax.nn.sigmoid(
+                jnp.concatenate([xv, hv], axis=-1) @ gw + gb)
+
+        reset_pre = record(fn_reset, x, hidden, self._gate_w, self._gate_b)
+        gate = record(fn_gate, x, hidden, self._gate_w, self._gate_b)
+        return out, reset_pre, gate

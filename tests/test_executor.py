@@ -114,3 +114,38 @@ def test_global_norm_clip(rng):
     xs = rng.normal(size=(4, 4)).astype("float32")
     g = exe.run(feed={"x": xs}, fetch_list=[p_g[0][1]])[0]
     assert np.sqrt((g ** 2).sum()) <= 1.0 + 1e-4
+
+
+def test_check_nan_inf_debug_mode():
+    """FLAGS_check_nan_inf parity: the op-by-op debug run names the first
+    op/var producing a non-finite value; clean programs pass through."""
+    import pytest
+
+    fluid.unique_name.switch()
+    xs = np.abs(np.random.RandomState(0).randn(2, 4)).astype("f4") + 1.0
+
+    # clean program passes through checked mode with matching results
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 4
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, size=4, act="relu", name="okfc")
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        v, = exe.run(main, feed={"x": xs}, fetch_list=[h],
+                     check_nan_inf=True)
+        v2, = exe.run(main, feed={"x": xs}, fetch_list=[h])
+        np.testing.assert_allclose(v, v2, rtol=1e-6)
+
+    # a nan-producing op is named precisely
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4])
+        h = fluid.layers.fc(x, size=4, act="relu", name="okfc2")
+        bad = fluid.layers.log(fluid.layers.scale(h, scale=-1.0))  # log(-v)
+        out = fluid.layers.reduce_sum(bad)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        with pytest.raises(RuntimeError, match="op 'log'.*nan"):
+            exe.run(main, feed={"x": xs}, fetch_list=[out],
+                    check_nan_inf=True)
