@@ -54,3 +54,36 @@ from .version import __version__  # noqa: F401
 
 # convenience re-exports matching fluid's top level
 from .clip import set_gradient_clip  # noqa: F401
+
+
+def memory_optimize(input_program=None, skip_opt_set=None, print_log=False,
+                    level=0, skip_grads=False):
+    """Ref ``python/paddle/fluid/transpiler/memory_optimization_transpiler.py``
+    (var reuse by liveness). The XLA build gets buffer sharing/reuse from
+    the compiler already; the knob that still matters on TPU is
+    rematerialization, so this flips the program's backward to recompute
+    forward activations in the backward pass (``jax.checkpoint``), trading
+    FLOPs for peak HBM exactly like the reference trades copies for reuse."""
+    from .core import framework as _fw
+
+    prog = input_program or _fw.default_main_program()
+    hit = False
+    for op in prog.global_block().ops:
+        if op.type == "autodiff":
+            op.attrs["remat"] = True
+            hit = True
+    if hit:
+        prog._version += 1
+    elif print_log:
+        print("memory_optimize: no backward in program; XLA buffer "
+              "assignment already reuses forward buffers")
+    return prog
+
+
+def release_memory(input_program=None, skip_opt_set=None):
+    """Ref ``release_memory`` (insert delete_var ops): subsumed — buffer
+    donation + XLA liveness free buffers at their last use. Kept for API
+    parity; returns the program unchanged."""
+    from .core import framework as _fw
+
+    return input_program or _fw.default_main_program()

@@ -59,7 +59,11 @@ class VarBase:
         self.stop_gradient = stop_gradient
         self.name = name
         self._grad = None
-        self._producer = None  # (pure_fn, input list) when tape-recorded
+        # (pure_fn, input list, forward-time values) when tape-recorded;
+        # values are SNAPSHOTTED so an in-place parameter update between
+        # forward and backward (optimizer.minimize on another loss) cannot
+        # silently change what the VJP is evaluated at
+        self._producer = None
 
     @property
     def shape(self):
@@ -106,9 +110,7 @@ class VarBase:
             g = grads.pop(id(v), None)
             if g is None:
                 continue
-            fn, inputs = v._producer
-            vals = [p._value if isinstance(p, VarBase) else p
-                    for p in inputs]
+            fn, inputs, vals = v._producer
             _, vjp_fn = jax.vjp(fn, *vals)
             in_grads = vjp_fn(g.astype(v._value.dtype))
             for p, ig in zip(inputs, in_grads):
@@ -193,7 +195,7 @@ def record(fn, *inputs):
     out = VarBase(fn(*vals))
     if _grad_enabled and any(isinstance(p, VarBase) and not p.stop_gradient
                              for p in inputs):
-        out._producer = (fn, list(inputs))
+        out._producer = (fn, list(inputs), vals)
     return out
 
 

@@ -15,10 +15,6 @@ __all__ = ["FC", "Linear", "Conv2D", "Pool2D", "BatchNorm", "Embedding",
            "LayerNorm", "Dropout", "PRelu", "GRUUnit"]
 
 
-def _v(x):
-    return x.value() if isinstance(x, VarBase) else jnp.asarray(x)
-
-
 class FC(Layer):
     def __init__(self, name_scope=None, size=None, input_dim=None,
                  num_flatten_dims=1, act=None, param_attr=None,
@@ -145,6 +141,9 @@ class BatchNorm(Layer):
         cshape = (1, -1) + (1,) * (xv.ndim - 2)
         eps, act = self._eps, self._act
         if self.training:
+            # the eager stats here feed ONLY the running-average update;
+            # the taped fn below recomputes them so its VJP stays correct
+            # (pure-fn tape nodes recompute by design)
             axes = tuple(i for i in range(xv.ndim) if i != 1)
             mu = jnp.mean(xv, axis=axes)
             var = jnp.var(xv, axis=axes)
@@ -282,28 +281,21 @@ class GRUUnit(Layer):
             self._build_once(x.shape[-1])
         h = self._hidden
 
-        def fn(xv, hv, gw, gb, cw, cb):
-            cat = jnp.concatenate([xv, hv], axis=-1)
-            gates = jax.nn.sigmoid(cat @ gw + gb)
-            u, r = gates[..., :h], gates[..., h:]
+        # the gate projection is computed ONCE; hidden/reset_pre are taped
+        # children of the shared gate node (reference GRUUnit's 3-output
+        # contract: updated_hidden, reset_hidden_pre, gate)
+        gate = record(
+            lambda xv, hv, gw, gb: jax.nn.sigmoid(
+                jnp.concatenate([xv, hv], axis=-1) @ gw + gb),
+            x, hidden, self._gate_w, self._gate_b)
+
+        def fn_hidden(g, xv, hv, cw, cb):
+            u, r = g[..., :h], g[..., h:]
             cat_r = jnp.concatenate([xv, r * hv], axis=-1)
             c = jnp.tanh(cat_r @ cw + cb)
             return u * hv + (1.0 - u) * c
 
-        out = record(fn, x, hidden, self._gate_w, self._gate_b,
-                     self._cand_w, self._cand_b)
-
-        # reference GRUUnit returns (updated_hidden, reset_hidden_pre,
-        # gate); recompute the aux outputs as their own taped nodes
-        def fn_reset(xv, hv, gw, gb):
-            gates = jax.nn.sigmoid(
-                jnp.concatenate([xv, hv], axis=-1) @ gw + gb)
-            return gates[..., h:] * hv
-
-        def fn_gate(xv, hv, gw, gb):
-            return jax.nn.sigmoid(
-                jnp.concatenate([xv, hv], axis=-1) @ gw + gb)
-
-        reset_pre = record(fn_reset, x, hidden, self._gate_w, self._gate_b)
-        gate = record(fn_gate, x, hidden, self._gate_w, self._gate_b)
+        out = record(fn_hidden, gate, x, hidden, self._cand_w,
+                     self._cand_b)
+        reset_pre = record(lambda g, hv: g[..., h:] * hv, gate, hidden)
         return out, reset_pre, gate

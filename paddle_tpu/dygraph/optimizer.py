@@ -43,7 +43,6 @@ class AdamOptimizer(_DygraphOptimizer):
         self._m = {}
         self._v = {}
         self._t = 0
-        self._applied = 0
 
     def minimize(self, loss, startup_program=None, parameter_list=None):
         self._t += 1

@@ -51,8 +51,20 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
               variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
               steps=(0.0, 0.0), offset=0.5, name=None):
     helper = LayerHelper("prior_box", name=name)
-    boxes = helper.create_variable_for_type_inference(dtype="float32")
-    var = helper.create_variable_for_type_inference(dtype="float32")
+    # shape inference mirrors the op: ars = dedup(1.0 + ratios (+flips)),
+    # boxes per cell = len(min)*len(ars) + len(min)*len(max)
+    ars = [1.0]
+    for r in aspect_ratios:
+        if all(abs(r - a) > 1e-6 for a in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+    k = len(min_sizes) * len(ars) + len(min_sizes) * len(max_sizes or [])
+    h, w = input.shape[2], input.shape[3]
+    boxes = helper.create_variable_for_type_inference(
+        dtype="float32", shape=(h, w, k, 4))
+    var = helper.create_variable_for_type_inference(
+        dtype="float32", shape=(h, w, k, 4))
     helper.append_op(
         "prior_box", {"Input": input, "Image": image},
         {"Boxes": boxes, "Variances": var},
@@ -67,8 +79,14 @@ def density_prior_box(input, image, densities=None, fixed_sizes=None,
                       fixed_ratios=None, variance=(0.1, 0.1, 0.2, 0.2),
                       clip=False, steps=(0.0, 0.0), offset=0.5, name=None):
     helper = LayerHelper("density_prior_box", name=name)
-    boxes = helper.create_variable_for_type_inference(dtype="float32")
-    var = helper.create_variable_for_type_inference(dtype="float32")
+    # mirror the op: sizes zip with densities
+    k = sum(int(d) ** 2 * len(fixed_ratios or [1.0])
+            for _, d in zip(fixed_sizes or [], densities or []))
+    h, w = input.shape[2], input.shape[3]
+    boxes = helper.create_variable_for_type_inference(
+        dtype="float32", shape=(h, w, k, 4))
+    var = helper.create_variable_for_type_inference(
+        dtype="float32", shape=(h, w, k, 4))
     helper.append_op(
         "density_prior_box", {"Input": input, "Image": image},
         {"Boxes": boxes, "Variances": var},

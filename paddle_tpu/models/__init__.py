@@ -19,4 +19,6 @@ from . import bert  # noqa: F401
 from . import deepfm  # noqa: F401
 from . import word2vec  # noqa: F401
 from . import ocr_ctc  # noqa: F401
+from . import ssd  # noqa: F401
+from . import label_semantic_roles  # noqa: F401
 from . import machine_translation  # noqa: F401

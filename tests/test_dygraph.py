@@ -125,3 +125,14 @@ def test_deep_tape_no_recursion_limit():
             y = y + 0.001
         y.sum().backward()
         np.testing.assert_allclose(w.gradient(), [1.0, 1.0], rtol=1e-6)
+
+
+def test_backward_uses_forward_time_values():
+    """Grad of a retained loss must be evaluated at the weights as they
+    were at forward time, even after an in-place optimizer update."""
+    with dygraph.guard():
+        w = dygraph.to_variable(np.array([2.0], "f4"))
+        loss = (w * w).sum()     # dloss/dw at w=2 is 4
+        w._value = jnp.asarray(np.array([10.0], "f4"))  # optimizer step
+        loss.backward()
+        np.testing.assert_allclose(w.gradient(), [4.0])

@@ -149,3 +149,34 @@ def test_check_nan_inf_debug_mode():
         with pytest.raises(RuntimeError, match="op 'log'.*nan"):
             exe.run(main, feed={"x": xs}, fetch_list=[out],
                     check_nan_inf=True)
+
+
+def test_memory_optimize_flips_remat():
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(4, 8).astype("f4"),
+            "y": rng.randn(4, 1).astype("f4")}
+
+    def run(optimize):
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 6
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            x = fluid.layers.data("x", shape=[8])
+            y = fluid.layers.data("y", shape=[1])
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(fluid.layers.fc(x, size=16, act="relu"),
+                                size=1), y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+            if optimize:
+                fluid.memory_optimize(main)
+                assert any(op.attr("remat")
+                           for op in main.global_block().ops
+                           if op.type == "autodiff")
+                fluid.release_memory(main)  # API parity no-op
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                    for _ in range(3)]
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5)

@@ -111,3 +111,27 @@ def test_ocr_ctc_trains():
                                    max_label_len=6, hid_dim=16)
     _train(spec, batch_size=4, steps=5,
            opt=fluid.optimizer.Adam(learning_rate=3e-3))
+
+
+def test_ssd_lite_trains_and_detects():
+    spec = models.ssd.ssd_lite()
+    _train(spec, batch_size=2, steps=4,
+           opt=fluid.optimizer.Adam(learning_rate=2e-3))
+    # inference outputs exist with the fixed-shape contract
+    dets = spec.fetches["detections"]
+    cnt = spec.fetches["det_count"]
+    exe = fluid.Executor(fluid.CPUPlace())
+    batch = spec.sample_batch(2, np.random.RandomState(1))
+    d, c = exe.run(feed=batch, fetch_list=[dets, cnt])
+    assert d.shape[1:] == (10, 6) and (c >= 0).all()
+
+
+def test_srl_crf_trains_and_decodes():
+    spec = models.label_semantic_roles.srl_crf()
+    _train(spec, batch_size=4, steps=5,
+           opt=fluid.optimizer.Adam(learning_rate=5e-3))
+    exe = fluid.Executor(fluid.CPUPlace())
+    batch = spec.sample_batch(4, np.random.RandomState(2))
+    path, = exe.run(feed=batch, fetch_list=[spec.fetches["decoded"]])
+    assert path.shape == (4, 16)
+    assert (path >= 0).all() and (path < 20).all()
