@@ -1,14 +1,25 @@
-"""Flash attention: Pallas TPU kernel + pure-jax reference.
+"""Flash attention: Pallas TPU kernels + pure-jax reference.
 
 The reference framework has no fused attention (2019-era; attention is
 composed from matmul/softmax layers, e.g. ``tests/unittests/dist_transformer.py``)
-— this is where the TPU build beats it: one VMEM-resident kernel with online
-softmax, no [T, T] HBM materialization.
+— this is where the TPU build beats it: VMEM-resident kernels with online
+softmax, no [T, T] HBM materialization in forward OR backward.
 
-Kernel design (see /opt/skills/guides/pallas_guide.md):
-  grid over (batch*heads, q blocks); K/V streamed in blocks; running
-  (max, sum, acc) online-softmax state in VMEM scratch; causal masking
-  skips fully-masked K blocks via the grid order.
+Kernel set (see /opt/skills/guides/pallas_guide.md):
+  * forward: grid (q blocks); K/V streamed in k blocks; running
+    (max, sum, acc) online-softmax state; per-key additive bias (the
+    padding-mask case), causal masking, and in-kernel dropout on the
+    attention weights via the TPU PRNG (pltpu.prng_*), seeded per
+    (batch*head, q block, k block) so the backward regenerates identical
+    masks.
+  * backward: two kernels — dQ (grid over q blocks) and dK/dV (grid over
+    k blocks) — using the saved row logsumexp and D = rowsum(dO * O),
+    the standard flash formulation; probabilities are recomputed per
+    block, never stored.
+
+CPU/tests: ``mha_reference`` is the numerics oracle; the kernels also run
+under ``interpret=True`` for hermetic CI (all paths except dropout, whose
+PRNG primitives are TPU-only).
 """
 
 import functools
@@ -18,24 +29,25 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+_INTERPRET = False  # tests flip this to run kernels on CPU
+
 
 def _use_pallas(q):
-    """Pallas path only on real TPU backends and head_dim friendly shapes."""
+    if _INTERPRET:
+        return True
     try:
         dev = jax.devices()[0]
     except Exception:
         return False
-    if dev.platform != "tpu":
-        return False
-    return True
+    return dev.platform == "tpu"
 
 
 # ---------------------------------------------------------------------------
-# reference (and CPU-test) implementation
+# reference (and CPU-fallback) implementation
 # ---------------------------------------------------------------------------
 
 def mha_reference(q, k, v, bias=None, causal=False, scale=None):
-    """q,k,v: [B, H, T, D]."""
+    """q,k,v: [B, H, T, D]; bias broadcastable to [B, H, Tq, Tk]."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -50,19 +62,35 @@ def mha_reference(q, k, v, bias=None, causal=False, scale=None):
 
 
 # ---------------------------------------------------------------------------
-# Pallas TPU kernel
+# Pallas TPU kernels
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
-                  kv_len):
-    """One q-block program. ``kv_len`` is the TRUE (unpadded) key length;
-    keys at positions >= kv_len are always masked so padded inputs are
-    handled exactly."""
+def _dropout_keep(shape, rate, seed, tags):
+    """In-kernel dropout keep-mask from the TPU PRNG. ``tags`` are python/
+    traced ints mixed into the seed so every (bh, q block, k block) gets an
+    independent, regenerable stream. Tags fold into ONE scalar (multi-
+    operand prng_seed hits a Mosaic lowering bug)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    mixed = seed.astype(jnp.int32)
+    for mult, tag in zip((1000003, 7919, 104729), tags):
+        mixed = mixed + jnp.int32(mult) * jnp.asarray(tag, jnp.int32)
+    pltpu.prng_seed(mixed)
+    bits = pltpu.prng_random_bits(shape)
+    # uniform in [0, 2^23): keep iff below keep_prob * 2^23
+    u = jax.lax.bitcast_convert_type(bits, jnp.uint32) & jnp.uint32(0x7FFFFF)
+    thresh = jnp.uint32(int((1.0 - rate) * float(1 << 23)))
+    return u < thresh
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
+                block_k, causal, scale, kv_len, dropout_rate):
     from jax.experimental import pallas as pl
 
-    q = q_ref[...]  # [block_q, d]
+    q = q_ref[...]
     block_q, d = q.shape
     kv_pad = k_ref.shape[0]
+    bh_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
 
     m_i = jnp.full((block_q,), -jnp.inf, jnp.float32)
@@ -73,11 +101,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
 
     def body(kb, carry):
         m_i, l_i, acc = carry
-        k = pl.load(k_ref, (pl.dslice(kb * block_k, block_k), slice(None)))
-        v = pl.load(v_ref, (pl.dslice(kb * block_k, block_k), slice(None)))
+        k = k_ref[pl.dslice(kb * block_k, block_k), :]
+        v = v_ref[pl.dslice(kb * block_k, block_k), :]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if bias_ref is not None:
+            b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
+            s = s + b[None, :].astype(jnp.float32)
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (block_q, block_k), 1)
         mask = k_pos < kv_len
@@ -91,91 +122,394 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k, causal, scale,
         p = jnp.where(mask, jnp.exp(s - m_safe[:, None]), 0.0)
         alpha = jnp.where(jnp.isfinite(m_i), jnp.exp(m_i - m_safe), 0.0)
         l_new = alpha * l_i + jnp.sum(p, axis=1)
+        p_use = p
+        if dropout_rate > 0.0:
+            keep = _dropout_keep((block_q, block_k), dropout_rate,
+                                 seed_ref[0, 0], (bh_idx, q_idx, kb))
+            p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
         acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc_new
 
     m_i, l_i, acc = jax.lax.fori_loop(0, num_kb, body, (m_i, l_i, acc))
-    o_ref[...] = (acc / jnp.maximum(l_i, 1e-30)[:, None]).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l_i, 1e-30)
+    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    # row logsumexp for the backward's prob recomputation; the stats ref
+    # holds the FULL row axis (Mosaic-friendly layout), sliced per program
+    lse = jnp.where(jnp.isfinite(m_i), m_i + jnp.log(l_safe), -jnp.inf)
+    lse_ref[0, pl.dslice(q_idx * block_q, block_q)] = lse.astype(jnp.float32)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal, scale):
-    if _use_pallas(q):
-        try:
-            return _flash_fwd_pallas_3d(q, k, v, causal, scale)
-        except Exception:
-            return mha_reference(q, k, v, None, causal, scale)
-    return mha_reference(q, k, v, None, causal, scale)
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+                   lse_ref, delta_ref, dq_ref, *, block_k, causal, scale,
+                   kv_len, dropout_rate):
+    from jax.experimental import pallas as pl
+
+    q = q_ref[...]
+    do = do_ref[...].astype(jnp.float32)
+    block_q, d = q.shape
+    kv_pad = k_ref.shape[0]
+    bh_idx = pl.program_id(0)
+    q_idx = pl.program_id(1)
+    lse = lse_ref[0, pl.dslice(q_idx * block_q, block_q)]
+    delta = delta_ref[0, pl.dslice(q_idx * block_q, block_q)]
+    # fully-masked rows store lse = -inf; guard like the dK/dV kernel so
+    # exp(s - lse) cannot produce NaN for them
+    # f32 mask (a bool [:, None] minor-dim insert doesn't lower on TPU)
+    lse_okf = jnp.isfinite(lse).astype(jnp.float32)
+    lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+
+    def body(kb, dq):
+        k = k_ref[pl.dslice(kb * block_k, block_k), :]
+        v = v_ref[pl.dslice(kb * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bias_ref is not None:
+            b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
+            s = s + b[None, :].astype(jnp.float32)
+        k_pos = kb * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < kv_len
+        if causal:
+            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            mask = mask & (q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
+                      0.0) * lse_okf[:, None]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bq, bk] = dO V^T
+        if dropout_rate > 0.0:
+            keep = _dropout_keep((block_q, block_k), dropout_rate,
+                                 seed_ref[0, 0], (bh_idx, q_idx, kb))
+            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+        ds = p * (dp - delta[:, None])  # [bq, bk]
+        dq = dq + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        return dq
+
+    dq = jax.lax.fori_loop(0, kv_pad // block_k, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
-def _flash_fwd(q, k, v, causal, scale):
-    out = _flash_attention(q, k, v, causal, scale)
-    return out, (q, k, v)
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+                    lse_ref, delta_ref, dk_ref, dv_ref, db_ref, *, block_q,
+                    causal, scale, kv_len, q_len, dropout_rate):
+    from jax.experimental import pallas as pl
+
+    k = k_ref[...]
+    v = v_ref[...]
+    block_k, d = k.shape
+    q_pad = q_ref.shape[0]
+    bh_idx = pl.program_id(0)
+    k_idx = pl.program_id(1)
+
+    k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    bias_blk = None
+    if bias_ref is not None:
+        bias_blk = bias_ref[0, pl.dslice(k_idx * block_k, block_k)]
+
+    def body(qb, carry):
+        dk, dv, db = carry
+        q = q_ref[pl.dslice(qb * block_q, block_q), :]
+        do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
+        delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if bias_blk is not None:
+            s = s + bias_blk[None, :].astype(jnp.float32)
+        mask = k_pos < kv_len
+        q_pos = qb * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        mask = mask & (q_pos < q_len)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        lse_okf = jnp.isfinite(lse).astype(jnp.float32)
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
+                      0.0) * lse_okf[:, None]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p_drop = p
+        if dropout_rate > 0.0:
+            keep = _dropout_keep((block_q, block_k), dropout_rate,
+                                 seed_ref[0, 0], (bh_idx, qb, k_idx))
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        ds = p * (dp - delta[:, None])
+        # (0),(0)-contracting dots transpose their operands; Mosaic only
+        # supports that relayout for 32-bit types, so run them in f32
+        dv = dv + jax.lax.dot_general(
+            p_drop, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, d]
+        dk = dk + jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        db = db + jnp.sum(ds, axis=0)  # per-key bias cotangent
+        return dk, dv, db
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dv0 = jnp.zeros((block_k, d), jnp.float32)
+    db0 = jnp.zeros((block_k,), jnp.float32)
+    dk, dv, db = jax.lax.fori_loop(0, q_pad // block_q, body,
+                                   (dk0, dv0, db0))
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+    if db_ref is not None:
+        db_ref[0, pl.dslice(k_idx * block_k, block_k)] = \
+            db.astype(db_ref.dtype)
 
 
-def _flash_bwd(causal, scale, res, g):
-    """Backward via recompute + jax autodiff of the reference formulation
-    (memory-light: no stored probs; XLA fuses the recompute)."""
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: mha_reference(q, k, v, None, causal,
-                                                   scale), q, k, v)
-    return vjp(g)
+# ---------------------------------------------------------------------------
+# pallas_call drivers — [BH, T, D] layout, one program per (bh, block)
+# ---------------------------------------------------------------------------
+
+def _pad_t(x, m):
+    r = (-x.shape[1]) % m
+    return jnp.pad(x, ((0, 0), (0, r), (0, 0))) if r else x
+
+
+def _pad_vec(x, m):
+    r = (-x.shape[1]) % m
+    return jnp.pad(x, ((0, 0), (0, r))) if r else x
+
+
+def _block_sizes(t, t_k):
+    """Mosaic wants the lane (last) dim of 1-D stats blocks divisible by
+    128, so real-TPU blocks are 128-multiples; interpret mode uses
+    8-multiples to exercise the padded-edge logic cheaply."""
+    m = 8 if _INTERPRET else 128
+
+    def r(x):
+        return ((x + m - 1) // m) * m
+
+    return min(256, r(t)), min(256, r(t_k))
+
+
+def _flash_fwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate):
+    """q,k,v: [BH, T, D]; bias [BH, Tk] additive per-key or None.
+    Returns (out [BH, T, D], lse [BH, T])."""
+    from jax.experimental import pallas as pl
+
+    bh, t, d = q.shape
+    t_k = k.shape[1]
+    block_q, block_k = _block_sizes(t, t_k)
+    qp, kp, vp = _pad_t(q, block_q), _pad_t(k, block_k), _pad_t(v, block_k)
+    t_pad, tk_pad = qp.shape[1], kp.shape[1]
+
+    kernel = functools.partial(
+        _fwd_kernel, block_k=block_k, causal=causal, scale=scale,
+        kv_len=t_k, dropout_rate=dropout_rate)
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+        pl.BlockSpec((None, tk_pad, d), lambda b, qi: (b, 0, 0)),
+        pl.BlockSpec((None, tk_pad, d), lambda b, qi: (b, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((None, 8, tk_pad),
+                                     lambda b, qi: (b, 0, 0)))
+        bp = _pad_vec(bias, block_k)
+        args.append(jnp.broadcast_to(bp[:, None, :], (bh, 8, tk_pad)))
+    in_specs.append(pl.BlockSpec((1, 1), lambda b, qi: (0, 0)))
+    args.append(jnp.asarray([[seed]], jnp.uint32))
+
+    def kernel_entry(*refs):
+        if bias is not None:
+            q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, l_ref = refs
+        else:
+            q_ref, k_ref, v_ref, s_ref, o_ref, l_ref = refs
+            b_ref = None
+        kernel(q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, l_ref)
+
+    out, lse = pl.pallas_call(
+        kernel_entry,
+        grid=(bh, t_pad // block_q),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+            # stats ride an 8-row sublane-padded block (Mosaic disallows
+            # 1-D effective blocks); row 0 is the data
+            pl.BlockSpec((None, 8, t_pad), lambda b, qi: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, 8, t_pad), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(*args)
+    return out[:, :t], lse[:, 0, :t]
+
+
+def _flash_bwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate,
+                    out, lse, do):
+    from jax.experimental import pallas as pl
+
+    bh, t, d = q.shape
+    t_k = k.shape[1]
+    block_q, block_k = _block_sizes(t, t_k)
+    qp, kp, vp = _pad_t(q, block_q), _pad_t(k, block_k), _pad_t(v, block_k)
+    dop = _pad_t(do, block_q)
+    t_pad, tk_pad = qp.shape[1], kp.shape[1]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # [BH, T]
+
+    def pad8(x):  # [BH, T] -> [BH, 8, T_pad] sublane-padded stats block
+        xp = _pad_vec(x, block_q)
+        return jnp.broadcast_to(xp[:, None, :], (bh, 8, xp.shape[1]))
+
+    lsep = pad8(lse)
+    deltap = pad8(delta)
+    if bias is not None:
+        bp = _pad_vec(bias, block_k)
+        biasp = jnp.broadcast_to(bp[:, None, :], (bh, 8, bp.shape[1]))
+    else:
+        biasp = None
+    seed_arr = jnp.asarray([[seed]], jnp.uint32)
+
+    # dQ: grid over q blocks
+    dq_kernel = functools.partial(
+        _bwd_dq_kernel, block_k=block_k, causal=causal, scale=scale,
+        kv_len=t_k, dropout_rate=dropout_rate)
+
+    def dq_entry(*refs):
+        if biasp is not None:
+            (q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, l_ref, de_ref,
+             dq_ref) = refs
+        else:
+            (q_ref, k_ref, v_ref, s_ref, do_ref, l_ref, de_ref,
+             dq_ref) = refs
+            b_ref = None
+        dq_kernel(q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, l_ref, de_ref,
+                  dq_ref)
+
+    in_specs = [
+        pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+        pl.BlockSpec((None, tk_pad, d), lambda b, qi: (b, 0, 0)),
+        pl.BlockSpec((None, tk_pad, d), lambda b, qi: (b, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    if biasp is not None:
+        in_specs.append(pl.BlockSpec((None, 8, tk_pad),
+                                     lambda b, qi: (b, 0, 0)))
+        args.append(biasp)
+    in_specs.append(pl.BlockSpec((1, 1), lambda b, qi: (0, 0)))
+    args.append(seed_arr)
+    in_specs += [
+        pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+        pl.BlockSpec((None, 8, t_pad), lambda b, qi: (b, 0, 0)),
+        pl.BlockSpec((None, 8, t_pad), lambda b, qi: (b, 0, 0)),
+    ]
+    args += [dop, lsep, deltap]
+    dq = pl.pallas_call(
+        dq_entry,
+        grid=(bh, t_pad // block_q),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((None, block_q, d), lambda b, qi: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t_pad, d), q.dtype),
+        interpret=_INTERPRET,
+    )(*args)
+
+    # dK/dV: grid over k blocks
+    dkv_kernel = functools.partial(
+        _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
+        kv_len=t_k, q_len=t, dropout_rate=dropout_rate)
+
+    def dkv_entry(*refs):
+        if biasp is not None:
+            (q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, l_ref, de_ref,
+             dk_ref, dv_ref, db_ref) = refs
+        else:
+            (q_ref, k_ref, v_ref, s_ref, do_ref, l_ref, de_ref,
+             dk_ref, dv_ref) = refs
+            b_ref = db_ref = None
+        dkv_kernel(q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, l_ref,
+                   de_ref, dk_ref, dv_ref, db_ref)
+
+    in_specs2 = [
+        pl.BlockSpec((None, t_pad, d), lambda b, ki: (b, 0, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+    ]
+    args2 = [qp, kp, vp]
+    if biasp is not None:
+        in_specs2.append(pl.BlockSpec((None, 8, tk_pad),
+                                      lambda b, ki: (b, 0, 0)))
+        args2.append(biasp)
+    in_specs2.append(pl.BlockSpec((1, 1), lambda b, ki: (0, 0)))
+    args2.append(seed_arr)
+    in_specs2 += [
+        pl.BlockSpec((None, t_pad, d), lambda b, ki: (b, 0, 0)),
+        pl.BlockSpec((None, 8, t_pad), lambda b, ki: (b, 0, 0)),
+        pl.BlockSpec((None, 8, t_pad), lambda b, ki: (b, 0, 0)),
+    ]
+    args2 += [dop, lsep, deltap]
+    out_specs2 = [
+        pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+        pl.BlockSpec((None, block_k, d), lambda b, ki: (b, ki, 0)),
+    ]
+    out_shape2 = [
+        jax.ShapeDtypeStruct((bh, tk_pad, d), k.dtype),
+        jax.ShapeDtypeStruct((bh, tk_pad, d), v.dtype),
+    ]
+    if biasp is not None:
+        out_specs2.append(pl.BlockSpec((None, 8, tk_pad),
+                                       lambda b, ki: (b, 0, 0)))
+        out_shape2.append(jax.ShapeDtypeStruct((bh, 8, tk_pad),
+                                               jnp.float32))
+    res = pl.pallas_call(
+        dkv_entry,
+        grid=(bh, tk_pad // block_k),
+        in_specs=in_specs2,
+        out_specs=out_specs2,
+        out_shape=out_shape2,
+        interpret=_INTERPRET,
+    )(*args2)
+    if biasp is not None:
+        dk, dv, db = res
+        db = db[:, 0, :t_k]
+    else:
+        dk, dv = res
+        db = None
+    return dq[:, :t], dk[:, :t_k], dv[:, :t_k], db
+
+
+# ---------------------------------------------------------------------------
+# differentiable wrapper
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_attention(q, k, v, bias, seed, causal, scale, dropout_rate):
+    out, _ = _flash_fwd_impl(q, k, v, bias, seed, causal, scale,
+                             dropout_rate)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, seed, causal, scale, dropout_rate):
+    out, lse = _flash_fwd_impl(q, k, v, bias, seed, causal, scale,
+                               dropout_rate)
+    return out, (q, k, v, bias, seed, out, lse)
+
+
+def _flash_bwd(causal, scale, dropout_rate, res, g):
+    q, k, v, bias, seed, out, lse = res
+    dq, dk, dv, db = _flash_bwd_impl(q, k, v, bias, seed, causal, scale,
+                                     dropout_rate, out, lse, g)
+    dbias = db.astype(bias.dtype) if bias is not None else None
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype), \
+        dbias, None
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
-
-
-def _flash_fwd_pallas_3d(q, k, v, causal, scale):
-    """Pallas forward with per-(batch*head) vmap to keep kernel refs 2-D
-    (the tiling-friendly layout: [T, D] blocks)."""
-    b, h, t, d = q.shape
-
-    def one(qi, ki, vi):
-        return _one_head_pallas(qi, ki, vi, causal, scale)
-
-    qq = q.reshape(b * h, t, d)
-    kk = k.reshape(b * h, k.shape[2], d)
-    vv = v.reshape(b * h, v.shape[2], d)
-    out = jax.vmap(one)(qq, kk, vv)
-    return out.reshape(b, h, t, d)
-
-
-def _one_head_pallas(q, k, v, causal, scale, block_q=256, block_k=256):
-    from jax.experimental import pallas as pl
-
-    t, d = q.shape
-    t_k = k.shape[0]
-    block_q = min(block_q, t)
-    block_k = min(block_k, t_k)
-
-    # pad both sequence axes up to block multiples; padded keys are masked
-    # inside the kernel (kv_len), padded q rows are sliced off after.
-    def pad_to(x, m):
-        r = (-x.shape[0]) % m
-        return jnp.pad(x, ((0, r), (0, 0))) if r else x
-
-    qp = pad_to(q, block_q)
-    kp = pad_to(k, block_k)
-    vp = pad_to(v, block_k)
-    t_pad = qp.shape[0]
-    tk_pad = kp.shape[0]
-
-    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
-                               scale=scale, kv_len=t_k)
-    out = pl.pallas_call(
-        kernel,
-        grid=(1, t_pad // block_q),
-        in_specs=[
-            pl.BlockSpec((block_q, d), lambda _, qi: (qi, 0)),
-            pl.BlockSpec((tk_pad, d), lambda _, qi: (0, 0)),
-            pl.BlockSpec((tk_pad, d), lambda _, qi: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((block_q, d), lambda _, qi: (qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((t_pad, d), q.dtype),
-    )(qp, kp, vp)
-    return out[:t]
 
 
 # ---------------------------------------------------------------------------
@@ -184,21 +518,59 @@ def _one_head_pallas(q, k, v, causal, scale, block_q=256, block_k=256):
 
 def flash_attention(q, k, v, num_heads, bias=None, causal=False,
                     dropout_rate=0.0, rng=None):
-    """q,k,v: [B, T, H*D] (packed heads). Returns [B, T, H*D]."""
+    """q,k,v: [B, T, H*D] (packed heads). ``bias``: None or additive
+    [B, 1, 1, Tk] / [B, Tk] key mask (the padding-mask form; richer bias
+    shapes fall back to the reference path). Returns [B, T, H*D]."""
     b, t, hd = q.shape
     d = hd // num_heads
     t_k = k.shape[1]
+
+    key_bias = None
+    ref_bias = bias
+    if bias is not None:
+        ba = bias
+        if (ba.ndim == 4 and ba.shape[1] == 1 and ba.shape[2] == 1
+                and ba.shape[0] in (1, b)):
+            key_bias = jnp.broadcast_to(
+                ba.reshape(ba.shape[0], t_k), (b, t_k))
+        elif ba.ndim == 2 and ba.shape[0] in (1, b):
+            key_bias = jnp.broadcast_to(ba, (b, t_k))
+            # the reference path adds bias to [B, H, Tq, Tk] logits:
+            # lift the 2-D key form so broadcasting stays right-aligned
+            ref_bias = key_bias[:, None, None, :]
 
     def split(x, t_):
         return x.reshape(b, t_, num_heads, d).transpose(0, 2, 1, 3)
 
     qh, kh, vh = split(q, t), split(k, t_k), split(v, t_k)
     scale = 1.0 / math.sqrt(d)
-    if bias is not None or dropout_rate > 0.0:
-        out = mha_reference(qh, kh, vh, bias, causal, scale)
+
+    pallas_ok = _use_pallas(q) and (bias is None or key_bias is not None)
+    # Mosaic-friendly head dims only; anything else degrades to the
+    # reference path instead of a lowering error
+    pallas_ok = pallas_ok and d % 8 == 0
+    if dropout_rate > 0.0 and (_INTERPRET or rng is None):
+        pallas_ok = False  # PRNG primitives are TPU-only
+
+    if not pallas_ok:
+        out = mha_reference(qh, kh, vh, ref_bias, causal, scale)
         if dropout_rate > 0.0 and rng is not None:
             keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, out.shape)
             out = out * keep / (1.0 - dropout_rate)
+        return out.transpose(0, 2, 1, 3).reshape(b, t, hd)
+
+    # flatten heads into the grid's leading axis
+    qf = qh.reshape(b * num_heads, t, d)
+    kf = kh.reshape(b * num_heads, t_k, d)
+    vf = vh.reshape(b * num_heads, t_k, d)
+    bf = (jnp.repeat(key_bias, num_heads, axis=0)
+          if key_bias is not None else None)
+    if dropout_rate > 0.0:
+        seed = jax.random.randint(rng, (), 0, np.iinfo(np.int32).max,
+                                  dtype=jnp.int32).astype(jnp.uint32)
     else:
-        out = _flash_attention(qh, kh, vh, causal, scale)
+        seed = jnp.uint32(0)
+    out = _flash_attention(qf, kf, vf, bf, seed, causal, scale,
+                           float(dropout_rate))
+    out = out.reshape(b, num_heads, t, d)
     return out.transpose(0, 2, 1, 3).reshape(b, t, hd)
