@@ -16,3 +16,4 @@ from . import attention_ops  # noqa: F401
 from . import decode_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
+from . import misc_ops  # noqa: F401

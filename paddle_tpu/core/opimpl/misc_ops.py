@@ -1,0 +1,676 @@
+"""Long-tail op coverage: metrics, losses, image/feature ops, sequence
+utilities (ref ``paddle/fluid/operators/*_op.cc`` — one kernel trio each
+there; one jnp function each here).
+
+Conventions: padded [B, ...] batches; ops that are LoD-shaped in the
+reference take explicit length inputs; dynamic-size outputs are padded
+with a validity count where needed (XLA static shapes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..op_registry import register, get, put, next_rng
+
+
+# ---------------- losses ----------------
+
+@register("rank_loss")
+def _rank_loss(env, op):
+    """Ref ``rank_loss_op.cc``: RankNet pairwise loss."""
+    label = get(env, op.input("Label"))
+    left = get(env, op.input("Left"))
+    right = get(env, op.input("Right"))
+    d = left - right
+    put(env, op.output("Out"),
+        jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register("modified_huber_loss")
+def _modified_huber(env, op):
+    """Ref ``modified_huber_loss_op.cc``: y in {0,1} -> {-1,1}."""
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y")) * 2.0 - 1.0
+    z = x * y
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    put(env, op.output("Out"), loss)
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(env, op):
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    sub = x - y
+    put(env, op.output("sub_result"), sub)
+    out = jnp.sum(jnp.square(sub).reshape(sub.shape[0], -1), axis=1,
+                  keepdims=True)
+    put(env, op.output("Out"), out)
+
+
+@register("l1_norm")
+def _l1_norm(env, op):
+    put(env, op.output("Out"),
+        jnp.sum(jnp.abs(get(env, op.input("X")))).reshape(()))
+
+
+@register("teacher_student_sigmoid_loss")
+def _teacher_student_loss(env, op):
+    """Ref ``teacher_student_sigmoid_loss_op.cc`` (CTR distillation)."""
+    x = get(env, op.input("X")).reshape(-1)
+    label = get(env, op.input("Label")).reshape(-1)
+    soft_max_up = op.attr("soft_max_up_bound", 15.0)
+    soft_max_lo = op.attr("soft_max_lower_bound", -15.0)
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    # teacher part (label in (0,1)): sigmoid CE with soft label; student
+    # part (label <=0 or >=1): hard sigmoid CE
+    hard = (label <= 0.0) | (label >= 1.0)
+    hard_lbl = (label > 0.0).astype(x.dtype)
+    ce = jnp.maximum(z, 0) - z * jnp.where(hard, hard_lbl, label) \
+        + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    put(env, op.output("Y"), ce.reshape(-1, 1))
+
+
+# ---------------- metrics ----------------
+
+@register("mean_iou")
+def _mean_iou(env, op):
+    """Ref ``mean_iou_op.cc``: mean intersection-over-union over classes."""
+    pred = get(env, op.input("Predictions")).reshape(-1).astype(jnp.int32)
+    label = get(env, op.input("Labels")).reshape(-1).astype(jnp.int32)
+    n = op.attr("num_classes")
+    inter = jnp.zeros((n,)).at[pred].add((pred == label).astype(jnp.float32))
+    pred_cnt = jnp.zeros((n,)).at[pred].add(1.0)
+    lbl_cnt = jnp.zeros((n,)).at[label].add(1.0)
+    # reference semantics: on a mismatch BOTH the predicted and the label
+    # class count a wrong, so correct + wrong covers the union
+    wrong = (pred_cnt - inter) + (lbl_cnt - inter)
+    correct = inter
+    # optional accumulation inputs (the reference's in-tensor pattern)
+    for slot, acc in (("InWrongs", "wrong"), ("InCorrects", "correct")):
+        for v in op.input_list(slot):
+            if acc == "wrong":
+                wrong = wrong + get(env, v).astype(jnp.float32)
+            else:
+                correct = correct + get(env, v).astype(jnp.float32)
+    union = correct + wrong
+    valid = union > 0
+    iou = jnp.where(valid, correct / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                      1.0)
+    put(env, op.output("OutMeanIou"), miou.reshape(()))
+    put(env, op.output("OutWrong"), wrong.astype(jnp.int32))
+    put(env, op.output("OutCorrect"), correct.astype(jnp.int32))
+
+
+@register("edit_distance")
+def _edit_distance(env, op):
+    """Ref ``edit_distance_op.cc``: Levenshtein over padded id sequences
+    with explicit lengths, scan-lowered DP over the hypothesis axis."""
+    hyp = get(env, op.input("Hyps")).astype(jnp.int32)      # [B, Th]
+    ref = get(env, op.input("Refs")).astype(jnp.int32)      # [B, Tr]
+    hyp_len = get(env, op.input("HypsLength")).reshape(-1).astype(jnp.int32)
+    ref_len = get(env, op.input("RefsLength")).reshape(-1).astype(jnp.int32)
+    norm = op.attr("normalized", False)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+
+    def one(h, r, hl, rl):
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def step(prev_row, i):
+            # prev_row: distances for hyp prefix i; compute prefix i+1
+            ins = prev_row[0] + 1.0
+
+            def inner(carry, j):
+                left = carry
+                sub = prev_row[j] + (h[i] != r[j]).astype(jnp.float32)
+                dele = prev_row[j + 1] + 1.0
+                cur = jnp.minimum(jnp.minimum(left + 1.0, dele), sub)
+                return cur, cur
+
+            _, rest = jax.lax.scan(inner, ins, jnp.arange(tr))
+            new_row = jnp.concatenate([ins[None], rest])
+            # beyond hyp length the row stays frozen
+            return jnp.where(i < hl, new_row, prev_row), None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(th))
+        d = final[rl]
+        if norm:
+            d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+        return d
+
+    out = jax.vmap(one)(hyp, ref, hyp_len, ref_len)
+    put(env, op.output("Out"), out.reshape(b, 1))
+    put(env, op.output("SequenceNum"), jnp.asarray(b, jnp.int64))
+
+
+@register("chunk_eval")
+def _chunk_eval(env, op):
+    """Ref ``chunk_eval_op.cc`` (IOB scheme): chunk-level precision /
+    recall / F1 for sequence labeling, masked by lengths.
+
+    Tag encoding (the reference's IOB layout): ``type*2`` = B-type,
+    ``type*2 + 1`` = I-type, ``num_chunk_types*2`` = O. A predicted chunk
+    is correct iff start, type AND end all match the label chunk."""
+    inf = get(env, op.input("Inference")).astype(jnp.int32)  # [B, T]
+    lbl = get(env, op.input("Label")).astype(jnp.int32)
+    length = get(env, op.input("SeqLength")).reshape(-1).astype(jnp.int32)
+    num_chunk_types = op.attr("num_chunk_types")
+    b, t = inf.shape
+    pos = jnp.arange(t)[None, :]
+    valid = pos < length[:, None]
+
+    def is_b(seq):
+        return (seq % 2 == 0) & (seq < num_chunk_types * 2) & valid
+
+    def is_i_of(seq, typ):
+        return seq == typ * 2 + 1
+
+    inf_b, lbl_b = is_b(inf), is_b(lbl)
+    n_inf = jnp.sum(inf_b.astype(jnp.int32))
+    n_lbl = jnp.sum(lbl_b.astype(jnp.int32))
+
+    # scan state per batch row: (open: matching chunk in progress,
+    # typ: its type, cnt). A chunk closes when the continuation (I-of-
+    # type) stops in either sequence; it counts iff both stop TOGETHER.
+    def step(carry, j):
+        open_, typ, cnt = carry
+        inf_j, lbl_j = inf[:, j], lbl[:, j]
+        inf_cont = is_i_of(inf_j, typ) & valid[:, j]
+        lbl_cont = is_i_of(lbl_j, typ) & valid[:, j]
+        both_end = open_ & ~inf_cont & ~lbl_cont
+        mismatch = open_ & (inf_cont != lbl_cont)
+        cnt = cnt + both_end.astype(jnp.int32)
+        open_ = open_ & ~both_end & ~mismatch
+        # a new matching chunk starts here (only if not continuing one)
+        start = (~open_ & inf_b[:, j] & lbl_b[:, j]
+                 & (inf_j == lbl_j))
+        typ = jnp.where(start, inf_j // 2, typ)
+        open_ = open_ | start
+        return (open_, typ, cnt), None
+
+    init = (jnp.zeros((b,), bool), jnp.zeros((b,), jnp.int32),
+            jnp.zeros((b,), jnp.int32))
+    (open_, _, cnt), _ = jax.lax.scan(step, init, jnp.arange(t))
+    n_correct = jnp.sum(cnt + open_.astype(jnp.int32))
+    p = n_correct / jnp.maximum(n_inf, 1)
+    r = n_correct / jnp.maximum(n_lbl, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-8)
+    put(env, op.output("Precision"), p.astype(jnp.float32).reshape(()))
+    put(env, op.output("Recall"), r.astype(jnp.float32).reshape(()))
+    put(env, op.output("F1-Score"), f1.astype(jnp.float32).reshape(()))
+    put(env, op.output("NumInferChunks"), n_inf.astype(jnp.int64))
+    put(env, op.output("NumLabelChunks"), n_lbl.astype(jnp.int64))
+    put(env, op.output("NumCorrectChunks"), n_correct.astype(jnp.int64))
+
+
+@register("positive_negative_pair")
+def _pos_neg_pair(env, op):
+    """Ref ``positive_negative_pair_op.cc``: ranking-quality pair counts
+    within query groups."""
+    score = get(env, op.input("Score")).reshape(-1)
+    label = get(env, op.input("Label")).reshape(-1)
+    qid = get(env, op.input("QueryID")).reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    higher_lbl = label[:, None] > label[None, :]
+    pos = jnp.sum((same_q & higher_lbl
+                   & (score[:, None] > score[None, :])).astype(jnp.float32))
+    neg = jnp.sum((same_q & higher_lbl
+                   & (score[:, None] < score[None, :])).astype(jnp.float32))
+    neu = jnp.sum((same_q & higher_lbl
+                   & (score[:, None] == score[None, :]))
+                  .astype(jnp.float32))
+    put(env, op.output("PositivePair"), pos.reshape(()))
+    put(env, op.output("NegativePair"), neg.reshape(()))
+    put(env, op.output("NeutralPair"), neu.reshape(()))
+
+
+# ---------------- image / feature ops ----------------
+
+@register("affine_channel")
+def _affine_channel(env, op):
+    x = get(env, op.input("X"))
+    scale = get(env, op.input("Scale"))
+    bias = get(env, op.input("Bias"))
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    put(env, op.output("Out"),
+        x * scale.reshape(shape) + bias.reshape(shape))
+
+
+@register("affine_grid")
+def _affine_grid(env, op):
+    """Ref ``affine_grid_op.cc``: theta [N, 2, 3] -> sampling grid."""
+    theta = get(env, op.input("Theta"))
+    h, w = op.attr("output_shape")[-2:]
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)
+    put(env, op.output("Output"), grid)
+
+
+@register("space_to_depth")
+def _space_to_depth(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    bs = op.attr("blocksize")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    put(env, op.output("Out"),
+        x.reshape(n, c * bs * bs, h // bs, w // bs))
+
+
+@register("shuffle_channel")
+def _shuffle_channel(env, op):
+    x = get(env, op.input("X"))
+    g = op.attr("group")
+    n, c, h, w = x.shape
+    put(env, op.output("Out"),
+        x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+        .reshape(n, c, h, w))
+
+
+@register("crop")
+def _crop(env, op):
+    x = get(env, op.input("X"))
+    offsets = op.attr("offsets")
+    shape = op.attr("shape")
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    put(env, op.output("Out"), x[sl])
+
+
+@register("pad_constant_like")
+def _pad_constant_like(env, op):
+    x = get(env, op.input("X"))  # big
+    y = get(env, op.input("Y"))  # small
+    val = op.attr("pad_value", 0.0)
+    pads = [(0, xd - yd) for xd, yd in zip(x.shape, y.shape)]
+    put(env, op.output("Out"), jnp.pad(y, pads, constant_values=val))
+
+
+@register("pool_with_index")
+def _pool_with_index(env, op):
+    """Ref ``pool_with_index_op.cc`` (max_pool2d_with_index). Mask holds
+    flat indices into the UNPADDED input (-inf padding never wins)."""
+    if op.attr("adaptive", False):
+        raise NotImplementedError("pool_with_index: adaptive mode")
+    x = get(env, op.input("X"))
+    n, c, h, w = x.shape
+    ks = op.attr("ksize")
+    if op.attr("global_pooling", False):
+        ks = [h, w]
+    strides = op.attr("strides", ks)
+    pads = op.attr("paddings", [0, 0])
+    ph_, pw_ = pads[0], pads[1]
+    if ph_ or pw_:
+        x = jnp.pad(x, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)),
+                    constant_values=-jnp.inf)
+    hp, wp = x.shape[2], x.shape[3]
+    kh, kw = ks[0], ks[1]
+    sh, sw = strides[0], strides[1]
+    oh, ow = (hp - kh) // sh + 1, (wp - kw) // sw + 1
+    # window extraction: [N, C, OH, OW, KH*KW]
+    wins = jnp.stack([
+        x[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw]
+        for i in range(kh) for j in range(kw)], axis=-1)
+    arg = jnp.argmax(wins, axis=-1)
+    out = jnp.max(wins, axis=-1)
+    ky, kx = arg // kw, arg % kw
+    gy = jnp.arange(oh)[None, None, :, None] * sh + ky - ph_
+    gx = jnp.arange(ow)[None, None, None, :] * sw + kx - pw_
+    put(env, op.output("Out"), out)
+    put(env, op.output("Mask"), (gy * w + gx).astype(jnp.int32))
+
+
+@register("unpool")
+def _unpool(env, op):
+    """Ref ``unpool_op.cc``: scatter pooled values back by max indices."""
+    x = get(env, op.input("X"))
+    mask = get(env, op.input("Indices")).astype(jnp.int32)
+    oh, ow = op.attr("unpooled_height"), op.attr("unpooled_width")
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    nidx = jnp.arange(n)[:, None, None, None]
+    cidx = jnp.arange(c)[None, :, None, None]
+    out = flat.at[nidx, cidx, mask].set(x)
+    put(env, op.output("Out"), out.reshape(n, c, oh, ow))
+
+
+@register("psroi_pool")
+def _psroi_pool(env, op):
+    """Ref ``psroi_pool_op.cc``: position-sensitive ROI average pooling
+    (batch-0 rois, fixed count — the repo ROI convention)."""
+    x = get(env, op.input("X"))  # [N, C, H, W], C = out_c * ph * pw
+    rois = get(env, op.input("ROIs"))  # [R, 4]
+    out_c = op.attr("output_channels")
+    ph = op.attr("pooled_height")
+    pw = op.attr("pooled_width")
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one(roi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / ph, rw / pw
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                ys = jnp.arange(h)
+                xs = jnp.arange(w)
+                in_y = ((ys >= jnp.floor(y1 + i * bin_h))
+                        & (ys < jnp.ceil(y1 + (i + 1) * bin_h)))
+                in_x = ((xs >= jnp.floor(x1 + j * bin_w))
+                        & (xs < jnp.ceil(x1 + (j + 1) * bin_w)))
+                m = in_y[:, None] & in_x[None, :]
+                cnt = jnp.maximum(jnp.sum(m.astype(x.dtype)), 1.0)
+                chan = (i * pw + j) * out_c + jnp.arange(out_c)
+                vals = jnp.sum(jnp.where(m[None], x[0, chan], 0.0),
+                               axis=(1, 2)) / cnt
+                outs.append(vals)
+        return jnp.stack(outs, axis=1).reshape(out_c, ph, pw)
+
+    put(env, op.output("Out"), jax.vmap(one)(rois))
+
+
+@register("spp")
+def _spp(env, op):
+    """Ref ``spp_op.cc``: spatial pyramid pooling."""
+    x = get(env, op.input("X"))
+    levels = op.attr("pyramid_height")
+    ptype = op.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        ys = [int(round(i * h / bins)) for i in range(bins + 1)]
+        xs = [int(round(i * w / bins)) for i in range(bins + 1)]
+        for i in range(bins):
+            for j in range(bins):
+                patch = x[:, :, ys[i]:max(ys[i + 1], ys[i] + 1),
+                          xs[j]:max(xs[j + 1], xs[j] + 1)]
+                red = jnp.max if ptype == "max" else jnp.mean
+                outs.append(red(patch, axis=(2, 3)))
+    put(env, op.output("Out"), jnp.concatenate(outs, axis=1))
+
+
+@register("similarity_focus")
+def _similarity_focus(env, op):
+    """Ref ``similarity_focus_op.cc``: focus mask from max positions of
+    selected channels."""
+    x = get(env, op.input("X"))  # [N, C, A, B]
+    axis = op.attr("axis")
+    indexes = op.attr("indexes")
+    if axis != 1:
+        raise NotImplementedError(
+            "similarity_focus: axis=%d not implemented (axis=1 only); "
+            "transpose the input instead" % axis)
+    n, c, a, bdim = x.shape
+    mask = jnp.zeros_like(x)
+    for idx in indexes:
+        if axis == 1:
+            sel = x[:, idx]  # [N, A, B]
+            ra = jnp.max(sel, axis=2, keepdims=True) == sel
+            rb = jnp.max(sel, axis=1, keepdims=True) == sel
+            m = (ra | rb).astype(x.dtype)[:, None]
+            mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
+    put(env, op.output("Out"), mask)
+
+
+@register("spectral_norm")
+def _spectral_norm(env, op):
+    """Ref ``spectral_norm_op.cc``: weight / sigma via power iteration
+    with the persisted u/v vectors."""
+    w = get(env, op.input("Weight"))
+    u = get(env, op.input("U")).reshape(-1)
+    v = get(env, op.input("V")).reshape(-1)
+    dim = op.attr("dim", 0)
+    iters = op.attr("power_iters", 1)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(iters, 0)):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), 1e-12)
+        u = mat @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), 1e-12)
+    sigma = u @ mat @ v
+    put(env, op.output("Out"), w / jnp.maximum(sigma, 1e-12))
+
+
+@register("random_crop")
+def _random_crop(env, op):
+    x = get(env, op.input("X"))
+    shape = op.attr("shape")
+    key = next_rng(env)
+    starts = []
+    for i, (xd, sd) in enumerate(zip(x.shape[-len(shape):], shape)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, xd - sd + 1))
+    lead = x.ndim - len(shape)
+    idx = [0] * lead + list(starts)
+    sizes = list(x.shape[:lead]) + list(shape)
+    put(env, op.output("Out"),
+        jax.lax.dynamic_slice(x, idx, sizes))
+
+
+# ---------------- misc tensor ops ----------------
+
+@register("multiplex")
+def _multiplex(env, op):
+    """Ref ``multiplex_op.cc``: out[i] = candidates[ids[i]][i]."""
+    ids = get(env, op.input("Ids")).reshape(-1).astype(jnp.int32)
+    xs = [get(env, v) for v in op.input_list("X")]
+    stacked = jnp.stack(xs, axis=0)  # [K, B, ...]
+    put(env, op.output("Out"), stacked[ids, jnp.arange(ids.shape[0])])
+
+
+@register("is_empty")
+def _is_empty(env, op):
+    x = get(env, op.input("X"))
+    put(env, op.output("Out"), jnp.asarray(x.size == 0))
+
+
+@register("minus")
+def _minus(env, op):
+    put(env, op.output("Out"),
+        get(env, op.input("X")) - get(env, op.input("Y")))
+
+
+@register("selu")
+def _selu(env, op):
+    x = get(env, op.input("X"))
+    scale = op.attr("scale", 1.0507009873554805)
+    alpha = op.attr("alpha", 1.6732632423543772)
+    put(env, op.output("Out"),
+        scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)))
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(env, op):
+    """Ref ``bilinear_tensor_product_op.cc``: out_k = x W_k y^T + b."""
+    x = get(env, op.input("X"))  # [B, M]
+    y = get(env, op.input("Y"))  # [B, N]
+    w = get(env, op.input("Weight"))  # [K, M, N]
+    bias = get(env, op.input("Bias"))
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    put(env, op.output("Out"), out)
+
+
+@register("add_position_encoding")
+def _add_position_encoding(env, op):
+    """Ref ``add_position_encoding_op.cc``: sinusoidal PE added in place."""
+    x = get(env, op.input("X"))  # [B, T, D]
+    alpha = op.attr("alpha", 1.0)
+    beta = op.attr("beta", 1.0)
+    b, t, d = x.shape
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
+    angle = pos / jnp.power(10000.0, 2.0 * i / d)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    put(env, op.output("Out"), alpha * x + beta * pe[None])
+
+
+@register("conv_shift")
+def _conv_shift(env, op):
+    """Ref ``conv_shift_op.cc``: circular correlation."""
+    x = get(env, op.input("X"))  # [B, M]
+    y = get(env, op.input("Y"))  # [B, N], N odd, N <= M
+    m = x.shape[1]
+    n = y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    put(env, op.output("Out"),
+        jnp.einsum("bmn,bn->bm", x[:, idx], y))
+
+
+@register("hash")
+def _hash(env, op):
+    """Ref ``hash_op.cc``: xxhash-style bucketed ids (capability parity:
+    deterministic multiplicative hash into num_hash buckets)."""
+    x = get(env, op.input("X")).astype(jnp.int64)  # [B, T]
+    num_hash = op.attr("num_hash", 1)
+    mod = op.attr("mod_by", 100000007)
+    outs = []
+    for i in range(num_hash):
+        seed = jnp.int64(0x9E3779B1 + i * 0x85EBCA77)
+        h = (x * seed) % jnp.int64(mod)
+        outs.append(h)
+    put(env, op.output("Out"), jnp.stack(outs, axis=-2))
+
+
+@register("data_norm")
+def _data_norm(env, op):
+    """Ref ``data_norm_op.cc``: normalization by accumulated batch stats
+    (CTR models); stats updated like summary counters."""
+    x = get(env, op.input("X"))
+    size = get(env, op.input("BatchSize"))
+    total = get(env, op.input("BatchSum"))
+    sq = get(env, op.input("BatchSquareSum"))
+    mean = total / jnp.maximum(size, 1e-4)
+    var = sq / jnp.maximum(size, 1e-4) - jnp.square(mean)
+    scale = jax.lax.rsqrt(jnp.maximum(var, 1e-4))
+    put(env, op.output("Y"), (x - mean) * scale)
+    put(env, op.output("Means"), mean)
+    put(env, op.output("Scales"), scale)
+    n = x.shape[0]
+    put(env, op.output("BatchSizeOut"), size + n)
+    put(env, op.output("BatchSumOut"), total + jnp.sum(x, axis=0))
+    put(env, op.output("BatchSquareSumOut"),
+        sq + jnp.sum(jnp.square(x), axis=0))
+
+
+# ---------------- sequence utilities ----------------
+
+@register("sequence_expand_as")
+def _sequence_expand_as(env, op):
+    """Padded re-design of ``sequence_expand_as_op.cc``: tile each row of
+    X to the length of the corresponding Y row (lengths input)."""
+    x = get(env, op.input("X"))          # [B, ...]
+    y_len = get(env, op.input("YLength")).reshape(-1).astype(jnp.int32)
+    maxlen = op.attr("maxlen")
+    tiled = jnp.repeat(x[:, None], maxlen, axis=1)
+    mask = jnp.arange(maxlen)[None, :] < y_len[:, None]
+    shape = mask.shape + (1,) * (x.ndim - 1)
+    put(env, op.output("Out"), tiled * mask.reshape(shape).astype(x.dtype))
+
+
+@register("sequence_reshape")
+def _sequence_reshape(env, op):
+    x = get(env, op.input("X"))  # [B, T, D]
+    new_dim = op.attr("new_dim")
+    b = x.shape[0]
+    put(env, op.output("Out"), x.reshape(b, -1, new_dim))
+
+
+@register("sequence_scatter")
+def _sequence_scatter(env, op):
+    """Padded ``sequence_scatter_op.cc``: scatter per-row updates at
+    per-row index lists."""
+    x = get(env, op.input("X"))          # [B, D]
+    ids = get(env, op.input("Ids")).astype(jnp.int32)  # [B, T]
+    upd = get(env, op.input("Updates"))  # [B, T]
+    mask = get(env, op.input("Mask"))
+    if mask is not None:
+        upd = upd * mask
+    b = x.shape[0]
+    bidx = jnp.arange(b)[:, None].repeat(ids.shape[1], 1)
+    put(env, op.output("Out"), x.at[bidx, ids].add(upd))
+
+
+# ---------------- optimizer extras ----------------
+
+@register("proximal_gd")
+def _proximal_gd(env, op):
+    """Ref ``proximal_gd_op.cc``: prox step with L1/L2."""
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    lr = get(env, op.input("LearningRate")).reshape(())
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    prox = p - lr * g
+    new_p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    put(env, op.output("ParamOut"), new_p)
+
+
+@register("proximal_adagrad")
+def _proximal_adagrad(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    m = get(env, op.input("Moment"))
+    lr = get(env, op.input("LearningRate")).reshape(())
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    m_new = m + g * g
+    alr = lr / jnp.sqrt(m_new + 1e-10)
+    prox = p - alr * g
+    new_p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - alr * l1, 0.0) \
+        / (1.0 + alr * l2)
+    put(env, op.output("ParamOut"), new_p)
+    put(env, op.output("MomentOut"), m_new)
+
+
+@register("sample_logits")
+def _sample_logits(env, op):
+    """Ref ``sample_logits_op.cc``: gather true + uniformly sampled class
+    logits for sampled softmax."""
+    logits = get(env, op.input("Logits"))  # [B, C]
+    labels = get(env, op.input("Labels")).astype(jnp.int32)  # [B, 1]
+    num = op.attr("num_samples")
+    b, c = logits.shape
+    key = next_rng(env)
+    samples = jax.random.randint(key, (b, num), 0, c)
+    all_idx = jnp.concatenate([labels.reshape(b, 1), samples], axis=1)
+    out = jnp.take_along_axis(logits, all_idx, axis=1)
+    # log-Q correction for uniform sampling (q = num/C per class): the
+    # sampled columns are over-represented by factor num/C relative to
+    # the full softmax, so subtract log q from them (true column exact)
+    logq = float(np.log(max(num, 1) / float(c)))
+    corr = jnp.concatenate(
+        [jnp.zeros((b, 1), out.dtype),
+         jnp.full((b, num), logq, out.dtype)], axis=1)
+    out = out - corr
+    put(env, op.output("SampledLogits"), out)
+    put(env, op.output("Samples"), all_idx)
+    put(env, op.output("SampledLabels"), jnp.zeros((b,), jnp.int64))
+
+
+@register("lstm_unit")
+def _lstm_unit(env, op):
+    """Ref ``lstm_unit_op.cc``: one fused LSTM cell step."""
+    x = get(env, op.input("X"))     # [B, 4H] pre-activations
+    c_prev = get(env, op.input("C_prev"))
+    forget_bias = op.attr("forget_bias", 0.0)
+    h4 = x.shape[1] // 4
+    i, f, o, j = (x[:, :h4], x[:, h4:2 * h4], x[:, 2 * h4:3 * h4],
+                  x[:, 3 * h4:])
+    c = (c_prev * jax.nn.sigmoid(f + forget_bias)
+         + jax.nn.sigmoid(i) * jnp.tanh(j))
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    put(env, op.output("C"), c)
+    put(env, op.output("H"), h)

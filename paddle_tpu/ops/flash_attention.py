@@ -46,8 +46,10 @@ def _use_pallas(q):
 # reference (and CPU-fallback) implementation
 # ---------------------------------------------------------------------------
 
-def mha_reference(q, k, v, bias=None, causal=False, scale=None):
-    """q,k,v: [B, H, T, D]; bias broadcastable to [B, H, Tq, Tk]."""
+def mha_reference(q, k, v, bias=None, causal=False, scale=None,
+                  dropout_rate=0.0, rng=None):
+    """q,k,v: [B, H, T, D]; bias broadcastable to [B, H, Tq, Tk].
+    Dropout (like the kernels) applies to the attention WEIGHTS."""
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
@@ -58,6 +60,9 @@ def mha_reference(q, k, v, bias=None, causal=False, scale=None):
         mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
         logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_rate > 0.0 and rng is not None:
+        keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, probs.shape)
+        probs = probs * keep / (1.0 - dropout_rate)
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
@@ -98,6 +103,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
     acc = jnp.zeros((block_q, d), jnp.float32)
 
     num_kb = kv_pad // block_k
+    if causal:
+        # blocks strictly above the diagonal are fully masked — skip them
+        num_kb = jnp.minimum(
+            num_kb, ((q_idx + 1) * q.shape[0] + block_k - 1) // block_k)
 
     def body(kb, carry):
         m_i, l_i, acc = carry
@@ -191,7 +200,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
             preferred_element_type=jnp.float32) * scale
         return dq
 
-    dq = jax.lax.fori_loop(0, kv_pad // block_k, body,
+    num_kb = kv_pad // block_k
+    if causal:
+        num_kb = jnp.minimum(
+            num_kb, ((q_idx + 1) * block_q + block_k - 1) // block_k)
+    dq = jax.lax.fori_loop(0, num_kb, body,
                            jnp.zeros((block_q, d), jnp.float32))
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
@@ -260,7 +273,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
     db0 = jnp.zeros((block_k,), jnp.float32)
-    dk, dv, db = jax.lax.fori_loop(0, q_pad // block_q, body,
+    qb_lo = (k_idx * block_k) // block_q if causal else 0
+    dk, dv, db = jax.lax.fori_loop(qb_lo, q_pad // block_q, body,
                                    (dk0, dv0, db0))
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
@@ -553,10 +567,9 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
         pallas_ok = False  # PRNG primitives are TPU-only
 
     if not pallas_ok:
-        out = mha_reference(qh, kh, vh, ref_bias, causal, scale)
-        if dropout_rate > 0.0 and rng is not None:
-            keep = jax.random.bernoulli(rng, 1.0 - dropout_rate, out.shape)
-            out = out * keep / (1.0 - dropout_rate)
+        # dropout applies to the attention weights, matching the kernels
+        out = mha_reference(qh, kh, vh, ref_bias, causal, scale,
+                            dropout_rate=dropout_rate, rng=rng)
         return out.transpose(0, 2, 1, 3).reshape(b, t, hd)
 
     # flatten heads into the grid's leading axis

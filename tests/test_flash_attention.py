@@ -151,3 +151,27 @@ def test_flash_2d_and_broadcast_bias_fallback(rng):
     fa._INTERPRET = True
     np.testing.assert_allclose(np.asarray(got_fb), np.asarray(want),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_flash_causal_multiblock_grads(rng):
+    """Sequences spanning multiple 256-blocks exercise the causal
+    block-skipping bounds in fwd, dQ and dK/dV kernels."""
+    b, h, t, d = 1, 1, 300, 8
+    q, k, v = _mk(rng, b, h, t, t, d)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(fa.flash_attention(q, k, v, num_heads=h,
+                                          causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref(q, k, v, h, causal=True) ** 2)
+
+    np.testing.assert_allclose(
+        np.asarray(fa.flash_attention(q, k, v, num_heads=h, causal=True)),
+        np.asarray(_ref(q, k, v, h, causal=True)), rtol=5e-4, atol=5e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-2, atol=1e-3,
+                                   err_msg="d%s" % name)

