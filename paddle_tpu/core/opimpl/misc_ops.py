@@ -143,7 +143,7 @@ def _edit_distance(env, op):
 
     out = jax.vmap(one)(hyp, ref, hyp_len, ref_len)
     put(env, op.output("Out"), out.reshape(b, 1))
-    put(env, op.output("SequenceNum"), jnp.asarray(b, jnp.int64))
+    put(env, op.output("SequenceNum"), jnp.asarray(b, jnp.int32))
 
 
 @register("chunk_eval")
@@ -201,9 +201,9 @@ def _chunk_eval(env, op):
     put(env, op.output("Precision"), p.astype(jnp.float32).reshape(()))
     put(env, op.output("Recall"), r.astype(jnp.float32).reshape(()))
     put(env, op.output("F1-Score"), f1.astype(jnp.float32).reshape(()))
-    put(env, op.output("NumInferChunks"), n_inf.astype(jnp.int64))
-    put(env, op.output("NumLabelChunks"), n_lbl.astype(jnp.int64))
-    put(env, op.output("NumCorrectChunks"), n_correct.astype(jnp.int64))
+    put(env, op.output("NumInferChunks"), n_inf.astype(jnp.int32))
+    put(env, op.output("NumLabelChunks"), n_lbl.astype(jnp.int32))
+    put(env, op.output("NumCorrectChunks"), n_correct.astype(jnp.int32))
 
 
 @register("positive_negative_pair")
@@ -235,8 +235,12 @@ def _affine_channel(env, op):
     scale = get(env, op.input("Scale"))
     bias = get(env, op.input("Bias"))
     shape = (1, -1) + (1,) * (x.ndim - 2)
-    put(env, op.output("Out"),
-        x * scale.reshape(shape) + bias.reshape(shape))
+    out = x
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    put(env, op.output("Out"), out)
 
 
 @register("affine_grid")
@@ -430,21 +434,24 @@ def _spectral_norm(env, op):
     v = get(env, op.input("V")).reshape(-1)
     dim = op.attr("dim", 0)
     iters = op.attr("power_iters", 1)
+    eps = op.attr("eps", 1e-12)
     mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
     for _ in range(max(iters, 0)):
         v = mat.T @ u
-        v = v / jnp.maximum(jnp.linalg.norm(v), 1e-12)
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
         u = mat @ v
-        u = u / jnp.maximum(jnp.linalg.norm(u), 1e-12)
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
     sigma = u @ mat @ v
-    put(env, op.output("Out"), w / jnp.maximum(sigma, 1e-12))
+    put(env, op.output("Out"), w / jnp.maximum(sigma, eps))
 
 
 @register("random_crop")
 def _random_crop(env, op):
     x = get(env, op.input("X"))
     shape = op.attr("shape")
-    key = next_rng(env)
+    seed = op.attr("seed", None)
+    key = (jax.random.PRNGKey(int(seed)) if seed is not None
+           else next_rng(env))
     starts = []
     for i, (xd, sd) in enumerate(zip(x.shape[-len(shape):], shape)):
         key, sub = jax.random.split(key)
@@ -532,14 +539,15 @@ def _conv_shift(env, op):
 def _hash(env, op):
     """Ref ``hash_op.cc``: xxhash-style bucketed ids (capability parity:
     deterministic multiplicative hash into num_hash buckets)."""
-    x = get(env, op.input("X")).astype(jnp.int64)  # [B, T]
+    x = get(env, op.input("X")).astype(jnp.uint32)  # [B, T]
     num_hash = op.attr("num_hash", 1)
     mod = op.attr("mod_by", 100000007)
     outs = []
     for i in range(num_hash):
-        seed = jnp.int64(0x9E3779B1 + i * 0x85EBCA77)
-        h = (x * seed) % jnp.int64(mod)
-        outs.append(h)
+        # multiplicative hash in wraparound uint32 (x64 stays disabled)
+        seed = jnp.uint32((0x9E3779B1 + i * 0x85EBCA77) & 0xFFFFFFFF)
+        h = (x * seed) % jnp.uint32(mod)
+        outs.append(h.astype(jnp.int32))
     put(env, op.output("Out"), jnp.stack(outs, axis=-2))
 
 
@@ -647,17 +655,14 @@ def _sample_logits(env, op):
     samples = jax.random.randint(key, (b, num), 0, c)
     all_idx = jnp.concatenate([labels.reshape(b, 1), samples], axis=1)
     out = jnp.take_along_axis(logits, all_idx, axis=1)
-    # log-Q correction for uniform sampling (q = num/C per class): the
-    # sampled columns are over-represented by factor num/C relative to
-    # the full softmax, so subtract log q from them (true column exact)
+    # log-Q correction (sampled-softmax convention: subtract log q from
+    # EVERY column, true class included — under uniform q it cancels in
+    # the softmax but keeps logits comparable to the reference's)
     logq = float(np.log(max(num, 1) / float(c)))
-    corr = jnp.concatenate(
-        [jnp.zeros((b, 1), out.dtype),
-         jnp.full((b, num), logq, out.dtype)], axis=1)
-    out = out - corr
+    out = out - logq
     put(env, op.output("SampledLogits"), out)
     put(env, op.output("Samples"), all_idx)
-    put(env, op.output("SampledLabels"), jnp.zeros((b,), jnp.int64))
+    put(env, op.output("SampledLabels"), jnp.zeros((b,), jnp.int32))
 
 
 @register("lstm_unit")

@@ -4,6 +4,7 @@ from . import nn
 from . import ops
 from . import tensor
 from . import detection
+from . import extras
 from . import io
 from . import control_flow
 from . import metric_op
@@ -18,6 +19,7 @@ from .io import *  # noqa: F401,F403
 from .metric_op import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from .control_flow import (StaticRNN, While, Switch, cond,  # noqa: F401
                            array_write, array_read, create_array,
                            array_length, IfElse, less_than, equal,

@@ -222,3 +222,62 @@ def test_proximal_gd(rng):
     check_output("proximal_gd",
                  {"Param": p, "Grad": g, "LearningRate": lr},
                  {"ParamOut": want}, {"l1": 0.05, "l2": 0.5}, atol=1e-6)
+
+
+def test_layer_wrappers_smoke(rng):
+    """The fluid.layers wrappers for the long-tail ops build and run."""
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[4, 8, 8])
+        seq = fluid.layers.reshape(x, [-1, 16, 16])
+        outs = [
+            fluid.layers.space_to_depth(x, 2),
+            fluid.layers.shuffle_channel(x, 2),
+            fluid.layers.affine_channel(x),
+            fluid.layers.selu(x),
+            fluid.layers.add_position_encoding(seq),
+            fluid.layers.sequence_reshape(seq, 8),
+        ]
+        o, m = fluid.layers.max_pool2d_with_index(x, [2, 2])
+        outs.append(fluid.layers.unpool(o, m, 8, 8))
+        a = fluid.layers.data("a", shape=[6])
+        b = fluid.layers.data("b", shape=[5])
+        outs.append(fluid.layers.bilinear_tensor_product(a, b, size=3))
+        miou, _, _ = fluid.layers.mean_iou(
+            fluid.layers.data("p", shape=[8], dtype="int64"),
+            fluid.layers.data("l", shape=[8], dtype="int64"), 4)
+        outs.append(miou)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        res = exe.run(main, feed={
+            "x": rng.randn(2, 4, 8, 8).astype("f4"),
+            "a": rng.randn(2, 6).astype("f4"),
+            "b": rng.randn(2, 5).astype("f4"),
+            "p": rng.randint(0, 4, (2, 8)).astype("int64"),
+            "l": rng.randint(0, 4, (2, 8)).astype("int64"),
+        }, fetch_list=outs)
+    for r in res:
+        assert np.isfinite(np.asarray(r, dtype="float64")).all()
+
+
+def test_hash_and_random_crop(rng):
+    ids = rng.randint(0, 1000, (2, 4)).astype("int64")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[4], dtype="int64")
+        h = fluid.layers.hash(xv, hash_size=97, num_hash=2)
+        img = fluid.layers.data("img", shape=[3, 8, 8])
+        cr = fluid.layers.random_crop(img, [3, 5, 5], seed=7)
+        cr2 = fluid.layers.random_crop(img, [3, 5, 5], seed=7)
+        exe = fluid.Executor(fluid.CPUPlace())
+        hv, c1, c2 = exe.run(
+            main, feed={"x": ids,
+                        "img": rng.randn(2, 3, 8, 8).astype("f4")},
+            fetch_list=[h, cr, cr2])
+    assert hv.shape == (2, 2, 4)
+    assert (hv >= 0).all() and (hv < 97).all()
+    # same ids hash identically; seeded crops are deterministic
+    assert (hv[0] == hv[0]).all()
+    np.testing.assert_allclose(c1, c2)
+    assert c1.shape == (2, 3, 5, 5)
