@@ -52,8 +52,31 @@ def run_op(env, op):
     old = None
     if cond_name is not None:
         old = {n: env[n] for n in op.output_arg_names if n in env}
-    with jax.named_scope(op.type):
-        impl(env, op)
+    try:
+        with jax.named_scope(op.type):
+            impl(env, op)
+    except (KeyError, NotImplementedError):
+        raise  # already carry their own op/var context
+    except Exception as e:
+        # enforce-style context (ref PADDLE_ENFORCE + OpError wrapping):
+        # name the failing op and its input shapes so shape/dtype errors
+        # point at the program line, not the jnp internals
+        shapes = []
+        for n in op.input_arg_names:
+            v = env.get(n)
+            shapes.append("%s=%s" % (
+                n, tuple(v.shape) if hasattr(v, "shape") else "?"))
+        note = ("  [operator '%s' inputs: %s -> outputs: %s]"
+                % (op.type, ", ".join(shapes),
+                   list(op.output_arg_names)))
+        if hasattr(e, "add_note"):  # py3.11+: keep type AND context
+            e.add_note(note)
+            raise
+        try:  # pre-3.11 fallback; multi-arg ctors can't be rebuilt
+            wrapped = type(e)(str(e) + "\n" + note)
+        except Exception:
+            wrapped = RuntimeError(str(e) + "\n" + note)
+        raise wrapped from e
     if cond_name is not None:
         # Switch-case guard: keep prior value where the case doesn't fire
         pred = env[cond_name].reshape(())

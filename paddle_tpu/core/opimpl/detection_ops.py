@@ -736,3 +736,185 @@ def _ssd_smooth_l1(env, op):
     d = jnp.abs(x - y)
     per = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
     put(env, op.output("Out"), jnp.sum(per, axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# Faster R-CNN training-path ops
+# ---------------------------------------------------------------------------
+
+def _rank_pos(key):
+    """rank_pos[i] = position of i in ascending-key order."""
+    n = key.shape[0]
+    return jnp.zeros((n,), jnp.int32).at[jnp.argsort(key)].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
+def _encode_center_size(ref_boxes, matched, one=1.0):
+    """Encode matched gt against reference boxes (pixel +1 convention)."""
+    rw = ref_boxes[:, 2] - ref_boxes[:, 0] + one
+    rh = ref_boxes[:, 3] - ref_boxes[:, 1] + one
+    rcx = ref_boxes[:, 0] + rw * 0.5
+    rcy = ref_boxes[:, 1] + rh * 0.5
+    gw = matched[:, 2] - matched[:, 0] + one
+    gh = matched[:, 3] - matched[:, 1] + one
+    gcx = matched[:, 0] + gw * 0.5
+    gcy = matched[:, 1] + gh * 0.5
+    return jnp.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                      jnp.log(gw / rw), jnp.log(gh / rh)], axis=1)
+
+
+@register("rpn_target_assign")
+def _rpn_target_assign(env, op):
+    """Ref ``rpn_target_assign_op.cc``: label anchors fg/bg by IoU and
+    emit regression targets.
+
+    Fixed-shape re-design: instead of emitting variable-length index
+    lists, outputs are per-anchor [N, A]: ScoreLabel (1 fg / 0 bg /
+    -1 ignore) and LocTarget [N, A, 4] (encoded gt for fg anchors).
+    Sampling quotas use score-ranked deterministic selection (XLA has no
+    cheap random subset; documented deviation from the reference's random
+    sampling — same quotas, deterministic choice)."""
+    anchors = get(env, op.input("Anchor")).reshape(-1, 4)  # [A, 4]
+    gt = get(env, op.input("GtBoxes"))                     # [N, G, 4]
+    n, g, _ = gt.shape
+    a = anchors.shape[0]
+    pos_thresh = op.attr("rpn_positive_overlap", 0.7)
+    neg_thresh = op.attr("rpn_negative_overlap", 0.3)
+    batch_per_im = int(op.attr("rpn_batch_size_per_im", 256))
+    fg_frac = op.attr("rpn_fg_fraction", 0.5)
+
+    valid_gt = (gt[..., 2] > gt[..., 0]) & (gt[..., 3] > gt[..., 1])
+
+    def one(gt_i, valid_i):
+        # pixel (+1) convention for BOTH the IoU and the encode, so the
+        # matching thresholds and regression targets agree
+        iou = _iou_matrix(anchors, gt_i, norm=False)  # [A, G]
+        iou = jnp.where(valid_i[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        # fg: above threshold, or the argmax anchor of each VALID gt
+        # (scatter-max: padded gt rows must not overwrite a True)
+        fg = best >= pos_thresh
+        gt_best_anchor = jnp.argmax(iou, axis=0)  # [G]
+        forced = jnp.zeros((a,), bool).at[gt_best_anchor].max(valid_i)
+        fg = fg | forced
+        bg = (best < neg_thresh) & ~fg
+        # quotas: top fg by IoU, top bg by (inverse) IoU
+        max_fg = int(batch_per_im * fg_frac)
+        fg_keep = fg & (_rank_pos(jnp.where(fg, -best, jnp.inf)) < max_fg)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        max_bg = batch_per_im - n_fg
+        bg_keep = bg & (_rank_pos(jnp.where(bg, best, jnp.inf)) < max_bg)
+        label = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+        tgt = _encode_center_size(anchors, gt_i[best_gt])
+        tgt = jnp.where(fg_keep[:, None], tgt, 0.0)
+        return label.astype(jnp.int32), tgt
+
+    labels, tgts = jax.vmap(one)(gt, valid_gt)
+    put(env, op.output("ScoreLabel"), labels)
+    put(env, op.output("LocTarget"), tgts)
+
+
+@register("generate_proposal_labels")
+def _generate_proposal_labels(env, op):
+    """Ref ``generate_proposal_labels_op.cc``: sample RoIs into fg/bg for
+    the second stage and build per-class regression targets.
+
+    Fixed-shape re-design: RoIs stay [N, R, 4]; outputs are per-roi
+    LabelsInt32 [N, R] (class id, 0 = background, -1 = unsampled),
+    BboxTargets [N, R, 4] (fg rows encoded vs matched gt), and the
+    fg/bg InsideWeights mask. Deterministic IoU-ranked sampling."""
+    rois = get(env, op.input("RpnRois"))      # [N, R, 4]
+    gt_cls = get(env, op.input("GtClasses")).astype(jnp.int32)  # [N, G]
+    gt_box = get(env, op.input("GtBoxes"))    # [N, G, 4]
+    bs_per_im = int(op.attr("batch_size_per_im", 128))
+    fg_frac = op.attr("fg_fraction", 0.25)
+    fg_thresh = op.attr("fg_thresh", 0.5)
+    bg_hi = op.attr("bg_thresh_hi", 0.5)
+    bg_lo = op.attr("bg_thresh_lo", 0.0)
+    n, r, _ = rois.shape
+
+    valid_gt = (gt_box[..., 2] > gt_box[..., 0]) \
+        & (gt_box[..., 3] > gt_box[..., 1])
+
+    def one(rois_i, gt_i, cls_i, vgt):
+        iou = _iou_matrix(rois_i, gt_i, norm=False)
+        iou = jnp.where(vgt[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        bidx = jnp.argmax(iou, axis=1)
+        fg = best >= fg_thresh
+        bg = (best < bg_hi) & (best >= bg_lo)
+        max_fg = int(bs_per_im * fg_frac)
+        fg_keep = fg & (_rank_pos(jnp.where(fg, -best, jnp.inf)) < max_fg)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        bg_keep = bg & (_rank_pos(jnp.where(bg, best, jnp.inf))
+                        < (bs_per_im - n_fg))
+        label = jnp.where(fg_keep, cls_i[bidx],
+                          jnp.where(bg_keep, 0, -1))
+        tgt = _encode_center_size(rois_i, gt_i[bidx])
+        tgt = jnp.where(fg_keep[:, None], tgt, 0.0)
+        return label.astype(jnp.int32), tgt, \
+            fg_keep.astype(jnp.float32)[:, None]
+
+    labels, tgts, w = jax.vmap(one)(rois, gt_box, gt_cls, valid_gt)
+    put(env, op.output("LabelsInt32"), labels)
+    put(env, op.output("BboxTargets"), tgts)
+    put(env, op.output("BboxInsideWeights"), w)
+
+
+@register("roi_perspective_transform")
+def _roi_perspective_transform(env, op):
+    """Ref ``roi_perspective_transform_op.cc``: warp quadrilateral ROIs to
+    a fixed rectangle by the perspective transform, bilinear-sampled
+    (batch-0 rois, the repo ROI convention)."""
+    x = get(env, op.input("X"))          # [N, C, H, W]
+    rois = get(env, op.input("ROIs"))    # [R, 8] quad corners
+    oh = op.attr("transformed_height")
+    ow = op.attr("transformed_width")
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def solve_h(quad):
+        # map unit rect corners -> quad (projective); standard 8x8 solve
+        src = jnp.asarray([[0.0, 0], [ow - 1, 0], [ow - 1, oh - 1],
+                           [0, oh - 1]])
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        for i in range(4):
+            sx, sy = src[i, 0], src[i, 1]
+            dx, dy = dst[i, 0], dst[i, 1]
+            rows.append(jnp.asarray(
+                [sx, sy, 1, 0, 0, 0, 0, 0]).at[6].set(-dx * sx)
+                .at[7].set(-dx * sy))
+            rows.append(jnp.asarray(
+                [0, 0, 0, sx, sy, 1, 0, 0]).at[6].set(-dy * sx)
+                .at[7].set(-dy * sy))
+        A = jnp.stack(rows)
+        b = dst.reshape(-1)
+        hvec = jnp.linalg.solve(A, b)
+        return jnp.concatenate([hvec, jnp.ones((1,))]).reshape(3, 3)
+
+    def one(quad):
+        hm = solve_h(quad)
+        ys, xs = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                              jnp.arange(ow, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(xs)
+        pts = jnp.stack([xs, ys, ones], axis=-1) @ hm.T
+        px = pts[..., 0] / jnp.maximum(pts[..., 2], 1e-8)
+        py = pts[..., 1] / jnp.maximum(pts[..., 2], 1e-8)
+        x0 = jnp.clip(jnp.floor(px).astype(jnp.int32), 0, w - 1)
+        y0 = jnp.clip(jnp.floor(py).astype(jnp.int32), 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        wx = px - x0
+        wy = py - y0
+        img = x[0]
+        out = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+               + img[:, y1, x0] * wy * (1 - wx)
+               + img[:, y0, x1] * (1 - wy) * wx
+               + img[:, y1, x1] * wy * wx)
+        inside = ((px >= 0) & (px <= w - 1) & (py >= 0) & (py <= h - 1))
+        return out * inside[None].astype(out.dtype)
+
+    put(env, op.output("Out"), jax.vmap(one)(rois))

@@ -21,4 +21,5 @@ from . import word2vec  # noqa: F401
 from . import ocr_ctc  # noqa: F401
 from . import ssd  # noqa: F401
 from . import label_semantic_roles  # noqa: F401
+from . import books  # noqa: F401
 from . import machine_translation  # noqa: F401

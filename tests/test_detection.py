@@ -272,3 +272,72 @@ def test_ssd_loss_and_detection_output_train(rng):
                 fetch_list=[out, cnt])
         assert dets.shape == (n, 5, 6)
         assert (cc >= 0).all() and (cc <= 5).all()
+
+
+def test_rpn_target_assign_semantics(rng):
+    anchors = np.array([[0, 0, 10, 10], [20, 20, 30, 30],
+                        [100, 100, 110, 110], [1, 1, 9, 9]], dtype="f4")
+    gt = np.array([[[0, 0, 10, 10], [0, 0, 0, 0]]], dtype="f4")  # 1 valid
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        av = fluid.layers.data("a", shape=[4], append_batch_size=False)
+        gv = fluid.layers.data("g", shape=[2, 4])
+        gb = main.global_block()
+        lab = gb.create_var(name="lab", dtype="int32")
+        tgt = gb.create_var(name="tgt", dtype="float32")
+        gb.append_op("rpn_target_assign", {"Anchor": av, "GtBoxes": gv},
+                     {"ScoreLabel": lab, "LocTarget": tgt},
+                     {"rpn_positive_overlap": 0.7,
+                      "rpn_negative_overlap": 0.3})
+        exe = fluid.Executor(fluid.CPUPlace())
+        L, T = exe.run(main, feed={"a": anchors, "g": gt},
+                       fetch_list=[lab, tgt])
+    assert L[0, 0] == 1            # perfect-overlap anchor is fg
+    assert L[0, 2] == 0            # far anchor is bg
+    np.testing.assert_allclose(T[0, 0], 0.0, atol=1e-5)  # exact match
+
+
+def test_generate_proposal_labels_semantics(rng):
+    rois = np.array([[[0, 0, 10, 10], [50, 50, 60, 60],
+                      [0, 0, 9, 11]]], dtype="f4")
+    gt = np.array([[[0, 0, 10, 10]]], dtype="f4")
+    cls = np.array([[3]], dtype="i4")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        rv = fluid.layers.data("r", shape=[3, 4])
+        gv = fluid.layers.data("g", shape=[1, 4])
+        cv = fluid.layers.data("c", shape=[1], dtype="int32")
+        gb = main.global_block()
+        outs = {"LabelsInt32": gb.create_var(name="l", dtype="int32"),
+                "BboxTargets": gb.create_var(name="t", dtype="float32"),
+                "BboxInsideWeights": gb.create_var(name="w",
+                                                   dtype="float32")}
+        gb.append_op("generate_proposal_labels",
+                     {"RpnRois": rv, "GtClasses": cv, "GtBoxes": gv},
+                     outs, {"fg_thresh": 0.5})
+        exe = fluid.Executor(fluid.CPUPlace())
+        L, T, W = exe.run(main, feed={"r": rois, "g": gt, "c": cls},
+                          fetch_list=[outs["LabelsInt32"],
+                                      outs["BboxTargets"],
+                                      outs["BboxInsideWeights"]])
+    assert L[0, 0] == 3       # IoU 1.0 -> fg with gt class
+    assert L[0, 1] == 0       # no overlap -> background
+    assert W[0, 0, 0] == 1.0 and W[0, 1, 0] == 0.0
+
+
+def test_roi_perspective_transform_identity(rng):
+    x = rng.randn(1, 2, 8, 8).astype("f4")
+    # axis-aligned quad covering [1,1]..[6,6] -> 6x6 output = crop
+    rois = np.array([[1, 1, 6, 1, 6, 6, 1, 6]], dtype="f4")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[2, 8, 8])
+        rv = fluid.layers.data("r", shape=[8], append_batch_size=False)
+        gb = main.global_block()
+        out = gb.create_var(name="o", dtype="float32")
+        gb.append_op("roi_perspective_transform",
+                     {"X": xv, "ROIs": rv}, {"Out": out},
+                     {"transformed_height": 6, "transformed_width": 6})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, = exe.run(main, feed={"x": x, "r": rois}, fetch_list=[out])
+    np.testing.assert_allclose(got[0], x[0, :, 1:7, 1:7], atol=1e-4)

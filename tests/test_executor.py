@@ -180,3 +180,23 @@ def test_memory_optimize_flips_remat():
                     for _ in range(3)]
 
     np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+def test_op_error_context():
+    """Shape errors name the failing op + input shapes (enforce parity)."""
+    import pytest
+
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        a = fluid.layers.data("a", shape=[4])
+        b = fluid.layers.data("b", shape=[5])
+        bad = fluid.layers.elementwise_add(a, b)  # incompatible at run time
+        exe = fluid.Executor(fluid.CPUPlace())
+        with pytest.raises(Exception) as ei:
+            exe.run(main, feed={"a": np.zeros((2, 4), "f4"),
+                                "b": np.zeros((2, 5), "f4")},
+                    fetch_list=[bad])
+    txt = "".join(getattr(ei.value, "__notes__", [])) + str(ei.value)
+    assert "operator 'elementwise_add'" in txt
+    assert "(2, 4)" in txt and "(2, 5)" in txt

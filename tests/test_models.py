@@ -135,3 +135,26 @@ def test_srl_crf_trains_and_decodes():
     path, = exe.run(feed=batch, fetch_list=[spec.fetches["decoded"]])
     assert path.shape == (4, 16)
     assert (path >= 0).all() and (path < 20).all()
+
+
+def test_book_models_train():
+    for builder, kwargs, bs in (
+            (models.books.fit_a_line, {}, 8),
+            (models.books.understand_sentiment, {"seq_len": 12,
+                                                 "stacked_num": 2}, 4),
+            (models.books.recommender_system, {}, 8)):
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 90125
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            spec = builder(**kwargs)
+            fluid.optimizer.Adam(learning_rate=5e-3).minimize(spec.loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            batch = spec.sample_batch(bs, np.random.RandomState(3))
+            losses = [float(exe.run(main, feed=batch,
+                                    fetch_list=[spec.loss])[0])
+                      for _ in range(6)]
+        assert np.isfinite(losses).all(), (builder.__name__, losses)
+        assert losses[-1] < losses[0], (builder.__name__, losses)
