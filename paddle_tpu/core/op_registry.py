@@ -55,8 +55,8 @@ def run_op(env, op):
     try:
         with jax.named_scope(op.type):
             impl(env, op)
-    except (KeyError, NotImplementedError):
-        raise  # already carry their own op/var context
+    except NotImplementedError:
+        raise  # already names the op type
     except Exception as e:
         # enforce-style context (ref PADDLE_ENFORCE + OpError wrapping):
         # name the failing op and its input shapes so shape/dtype errors

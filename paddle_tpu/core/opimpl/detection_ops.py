@@ -750,13 +750,17 @@ def _rank_pos(key):
 
 
 def _encode_center_size(ref_boxes, matched, one=1.0):
-    """Encode matched gt against reference boxes (pixel +1 convention)."""
-    rw = ref_boxes[:, 2] - ref_boxes[:, 0] + one
-    rh = ref_boxes[:, 3] - ref_boxes[:, 1] + one
+    """Encode matched gt against reference boxes (pixel +1 convention;
+    the normalized/variance-scaled variants live in _box_coder and
+    _ssd_encode_matched). Degenerate matches (padded zero-area gt rows
+    that scored IoU 0 and are masked out downstream) are clamped so the
+    log never produces -inf into the masked lanes."""
+    rw = jnp.maximum(ref_boxes[:, 2] - ref_boxes[:, 0] + one, 1e-6)
+    rh = jnp.maximum(ref_boxes[:, 3] - ref_boxes[:, 1] + one, 1e-6)
     rcx = ref_boxes[:, 0] + rw * 0.5
     rcy = ref_boxes[:, 1] + rh * 0.5
-    gw = matched[:, 2] - matched[:, 0] + one
-    gh = matched[:, 3] - matched[:, 1] + one
+    gw = jnp.maximum(matched[:, 2] - matched[:, 0] + one, 1e-6)
+    gh = jnp.maximum(matched[:, 3] - matched[:, 1] + one, 1e-6)
     gcx = matched[:, 0] + gw * 0.5
     gcy = matched[:, 1] + gh * 0.5
     return jnp.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,

@@ -20,7 +20,7 @@ from .metric_op import *  # noqa: F401,F403
 from .sequence_lod import *  # noqa: F401,F403
 from .detection import *  # noqa: F401,F403
 from .extras import *  # noqa: F401,F403
-from .control_flow import (StaticRNN, While, Switch, cond,  # noqa: F401
+from .control_flow import (StaticRNN, DynamicRNN, While, Switch, cond,  # noqa: F401
                            array_write, array_read, create_array,
                            array_length, IfElse, less_than, equal,
                            increment)

@@ -11,7 +11,7 @@ from ..core import framework
 from ..core.framework import Variable
 from ..core.layer_helper import LayerHelper
 
-__all__ = ["StaticRNN", "While", "Switch", "cond", "increment",
+__all__ = ["StaticRNN", "DynamicRNN", "While", "Switch", "cond", "increment",
            "less_than", "equal", "array_write", "array_read",
            "create_array", "array_length", "IfElse"]
 
@@ -384,3 +384,76 @@ class IfElse:
             "both branches must call output() with the same arity"
         return _append_cond_block(self._cond, self._branches[True].ops,
                                   t_outs, self._branches[False].ops, f_outs)
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length RNN (ref ``control_flow.py`` DynamicRNN, which walks
+    LoD sequences shrinking the live batch each step).
+
+    Padded-batch redesign: same step-block recording as StaticRNN, but the
+    caller passes per-row ``lengths`` at call time; memory updates FREEZE
+    once a row's length is exhausted (so final memories equal the state at
+    each row's last valid step, matching the reference's semantics of
+    shorter sequences retiring early) and step outputs beyond a row's
+    length are zeroed.
+
+        drnn = DynamicRNN()
+        with drnn.step():                     # block() also accepted
+            x_t = drnn.step_input(x)          # x: [B, T, D]
+            h = drnn.memory(shape=[H], batch_ref=x)
+            nh = some_layers(x_t, h)
+            drnn.update_memory(h, nh)
+            drnn.step_output(nh)
+        out = drnn(lengths=seq_len)           # [B, T, H], zero-padded
+    """
+
+    def block(self):
+        return self.step()
+
+    def __call__(self, lengths=None, **kwargs):
+        if lengths is None:
+            return super().__call__(**kwargs)
+        from . import nn, tensor
+
+        prog = framework.default_main_program()
+        x_full = self._step_inputs[0][1]  # [B, T, ...]
+        seq_len = x_full.shape[1]
+
+        # [B, T] time indices as an extra scanned input (the step mask
+        # needs its own t), built in the OUTER block
+        t_row = tensor.unsqueeze(
+            tensor.range(0, seq_len, 1, "float32"), [0])   # [1, T]
+        zero_b = tensor.fill_constant_batch_size_like(
+            x_full, [1, 1], "float32", 0.0)                # [B, 1]
+        t_full = nn.elementwise_add(zero_b, t_row)         # [B, T]
+        len_f = tensor.cast(lengths, "float32")            # [B]
+
+        # inject masking ops INTO the recorded step block
+        saved_idx = prog.current_block_idx
+        prog.current_block_idx = self._block.idx
+        self._entered = True
+        try:
+            t_step = self.step_input(t_full)               # [B] per step
+            alive = tensor.cast(
+                less_than(t_step, len_f), "float32")       # [B]
+            for pre, _ in list(self._mems):
+                post = self._mem_updates[pre.name]
+                m = alive
+                for _ in range(len(post.shape) - 1):
+                    m = tensor.unsqueeze(m, [-1])
+                frozen = nn.elementwise_add(
+                    nn.elementwise_mul(post, m),
+                    nn.elementwise_mul(
+                        pre, nn.scale(m, scale=-1.0, bias=1.0)))
+                self._mem_updates[pre.name] = frozen
+            masked_outs = []
+            for o in self._step_outputs:
+                m = alive
+                for _ in range(len(o.shape) - 1):
+                    m = tensor.unsqueeze(m, [-1])
+                masked_outs.append(nn.elementwise_mul(o, m))
+            self._step_outputs = masked_outs
+        finally:
+            self._entered = False
+            prog.current_block_idx = saved_idx
+        return super().__call__(**kwargs)

@@ -173,3 +173,43 @@ def test_mt_overfit_and_beam_decode():
     best = s[:, 0, :]  # top beam, [B, T]
     acc = (best == trg_out).mean()
     assert acc > 0.95, acc
+
+
+def test_dynamic_rnn_freezes_at_length(rng):
+    """DynamicRNN (padded redesign): memories freeze at each row's length,
+    outputs beyond the length are zero; equals StaticRNN on the prefix."""
+    import paddle_tpu as fluid
+
+    fluid.unique_name.switch()
+    b, t, d, h = 3, 6, 4, 5
+    x_np = rng.randn(b, t, d).astype("f4")
+    lens = np.array([6, 3, 1], dtype="int64")
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 7
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[t, d])
+        ln = fluid.layers.data("ln", shape=[], dtype="int64")
+        drnn = fluid.layers.DynamicRNN()
+        with drnn.block():
+            x_t = drnn.step_input(x)
+            mem = drnn.memory(shape=[h], batch_ref=x)
+            nh = fluid.layers.fc(fluid.layers.concat([x_t, mem], axis=-1),
+                                 size=h, act="tanh", name="cell")
+            drnn.update_memory(mem, nh)
+            drnn.step_output(nh)
+        out = drnn(lengths=ln)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        o, = exe.run(main, feed={"x": x_np, "ln": lens}, fetch_list=[out])
+    # outputs past each row's length are exactly zero
+    assert np.abs(o[1, 3:]).max() == 0.0
+    assert np.abs(o[2, 1:]).max() == 0.0
+    # the full-length row keeps nonzero activity at EVERY step
+    assert (np.abs(o[0]).max(axis=-1) > 0.0).all()
+    # row 2's step-0 output must equal a full-length row's step-0 under the
+    # same weights: recompute row 0 prefix invariance by feeding len=6 all
+    o2, = exe.run(main, feed={"x": x_np,
+                              "ln": np.array([6, 6, 6], "int64")},
+                  fetch_list=[out])
+    np.testing.assert_allclose(o[2, 0], o2[2, 0], rtol=1e-6)
+    np.testing.assert_allclose(o[1, :3], o2[1, :3], rtol=1e-6)
