@@ -679,3 +679,108 @@ def _lstm_unit(env, op):
     h = jnp.tanh(c) * jax.nn.sigmoid(o)
     put(env, op.output("C"), c)
     put(env, op.output("H"), h)
+
+
+@register("ctc_align")
+def _ctc_align(env, op):
+    """Ref ``ctc_align_op.cc``: CTC greedy decode post-processing — merge
+    repeats, drop blanks. Padded re-design: [B, T] ids + lengths in,
+    front-compacted [B, T] ids (padding_value tail) + OutLength out."""
+    x = get(env, op.input("Input")).astype(jnp.int32)  # [B, T]
+    lens = get(env, op.input("InputLength"))
+    blank = op.attr("blank", 0)
+    pad_val = op.attr("padding_value", 0)
+    b, t = x.shape
+    pos = jnp.arange(t)[None, :]
+    valid = pos < lens.reshape(-1, 1)
+    first = pos == 0
+    keep = valid & (x != blank) & (first | (x != jnp.roll(x, 1, axis=1)))
+    # stable front-compaction: order by (dropped, position)
+    order = jnp.argsort(jnp.where(keep, pos, t + pos), axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    n_keep = jnp.sum(keep.astype(jnp.int32), axis=1)
+    out = jnp.where(pos < n_keep[:, None], compacted, pad_val)
+    put(env, op.output("Output"), out)
+    put(env, op.output("OutputLength"), n_keep)
+
+
+@register("detection_map")
+def _detection_map(env, op):
+    """Ref ``detection_map_op.cc``: mean average precision over classes.
+
+    Fixed-shape re-design of the LoD inputs: DetectRes [N, D, 6]
+    (label, score, x1, y1, x2, y2; label < 0 = padding), GtLabel [N, G],
+    GtBox [N, G, 4] (zero-area rows = padding). 'integral' or '11point'
+    AP; greedy score-ordered matching, one gt per detection."""
+    det = get(env, op.input("DetectRes"))
+    gt_label = get(env, op.input("GtLabel")).astype(jnp.int32)
+    gt_box = get(env, op.input("GtBox"))
+    iou_t = op.attr("overlap_threshold", 0.5)
+    ap_type = op.attr("ap_type", "integral")
+    class_num = int(op.attr("class_num"))
+    n, d_cnt, _ = det.shape
+    g_cnt = gt_box.shape[1]
+
+    from .detection_ops import _iou_matrix
+
+    gt_valid = (gt_box[..., 2] > gt_box[..., 0]) \
+        & (gt_box[..., 3] > gt_box[..., 1])
+
+    # flatten detections with their image index; sort all by score desc
+    img_idx = jnp.repeat(jnp.arange(n), d_cnt)
+    dl = det[..., 0].reshape(-1).astype(jnp.int32)
+    ds = det[..., 1].reshape(-1)
+    db = det[..., 2:].reshape(-1, 4)
+    d_valid = dl >= 0
+    order = jnp.argsort(jnp.where(d_valid, -ds, jnp.inf))
+    img_idx, dl, db, d_valid = (img_idx[order], dl[order], db[order],
+                                d_valid[order])
+
+    # class-independent IoU rows, computed ONCE (not per vmapped class)
+    ious = jax.vmap(lambda bx, ii: _iou_matrix(
+        bx[None], gt_box[ii], norm=False)[0])(db, img_idx)  # [ND, G]
+
+    def run_class(c):
+        n_gt = jnp.sum((gt_label == c) & gt_valid)
+
+        def step(used, i):
+            # used: [N, G] gt-consumed flags. Reference semantics
+            # (detection_map_op.cc): a detection matches ONLY its
+            # argmax-IoU same-class gt; if that gt was already consumed
+            # by a higher-scored detection, this one is a false positive.
+            iou = ious[i]
+            same = (gt_label[img_idx[i]] == c) & gt_valid[img_idx[i]]
+            cand = jnp.where(same, iou, -1.0)
+            j = jnp.argmax(cand)
+            overlap_ok = cand[j] >= iou_t
+            fresh = ~used[img_idx[i], j]
+            hit = overlap_ok & fresh & d_valid[i] & (dl[i] == c)
+            used = used.at[img_idx[i], j].set(used[img_idx[i], j] | hit)
+            tp = jnp.where(d_valid[i] & (dl[i] == c),
+                           jnp.where(hit, 1.0, 0.0), jnp.nan)
+            return used, tp
+
+        used0 = jnp.zeros((n, g_cnt), bool)
+        _, tps = jax.lax.scan(step, used0, jnp.arange(img_idx.shape[0]))
+        is_c = ~jnp.isnan(tps)
+        tp = jnp.where(is_c, tps, 0.0)
+        fp = jnp.where(is_c, 1.0 - tps, 0.0)
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        recall = ctp / jnp.maximum(n_gt, 1)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-9)
+        if ap_type == "11point":
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jax.vmap(lambda r: jnp.max(
+                jnp.where(recall >= r, precision, 0.0)))(pts)
+            ap = jnp.mean(pmax)
+        else:  # integral
+            d_rec = jnp.diff(jnp.concatenate([jnp.zeros((1,)), recall]))
+            ap = jnp.sum(precision * d_rec * is_c)
+        return jnp.where(n_gt > 0, ap, jnp.nan)
+
+    aps = jax.vmap(run_class)(jnp.arange(1, class_num))  # skip background 0
+    present = ~jnp.isnan(aps)
+    m_ap = jnp.sum(jnp.where(present, aps, 0.0)) / jnp.maximum(
+        jnp.sum(present.astype(jnp.float32)), 1.0)
+    put(env, op.output("MAP"), m_ap.reshape(()))

@@ -281,3 +281,57 @@ def test_hash_and_random_crop(rng):
     assert (hv[0] == hv[0]).all()
     np.testing.assert_allclose(c1, c2)
     assert c1.shape == (2, 3, 5, 5)
+
+
+def test_ctc_align(rng):
+    x = np.array([[0, 1, 1, 0, 2, 2, 3, 0],
+                  [5, 5, 0, 5, 0, 0, 0, 0]], dtype="int64")
+    lens = np.array([8, 4], dtype="int64")
+    want = np.array([[1, 2, 3, 0, 0, 0, 0, 0],
+                     [5, 5, 0, 0, 0, 0, 0, 0]], dtype="int32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[8], dtype="int64")
+        lv = fluid.layers.data("l", shape=[], dtype="int64")
+        gb = main.global_block()
+        out = gb.create_var(name="o", dtype="int32")
+        ol = gb.create_var(name="ol", dtype="int32")
+        gb.append_op("ctc_align", {"Input": xv, "InputLength": lv},
+                     {"Output": out, "OutputLength": ol}, {"blank": 0})
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, gl = exe.run(main, feed={"x": x, "l": lens},
+                          fetch_list=[out, ol])
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(gl, [3, 2])
+
+
+def test_detection_map(rng):
+    """Perfect detections -> mAP 1; one spurious high-score fp lowers it."""
+    gt_box = np.array([[[0, 0, 10, 10], [20, 20, 30, 30]]], dtype="f4")
+    gt_lbl = np.array([[1, 2]], dtype="i4")
+
+    def run_map(det):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            dv = fluid.layers.data("d", shape=[det.shape[1], 6])
+            gl = fluid.layers.data("gl", shape=[2], dtype="int32")
+            gv = fluid.layers.data("gb", shape=[2, 4])
+            blk = main.global_block()
+            out = blk.create_var(name="map", dtype="float32")
+            blk.append_op("detection_map",
+                          {"DetectRes": dv, "GtLabel": gl, "GtBox": gv},
+                          {"MAP": out},
+                          {"class_num": 3, "ap_type": "integral"})
+            exe = fluid.Executor(fluid.CPUPlace())
+            m, = exe.run(main, feed={"d": det, "gl": gt_lbl, "gb": gt_box},
+                         fetch_list=[out])
+        return float(m)
+
+    perfect = np.array([[[1, 0.9, 0, 0, 10, 10],
+                         [2, 0.8, 20, 20, 30, 30],
+                         [-1, 0, 0, 0, 0, 0]]], dtype="f4")
+    assert abs(run_map(perfect) - 1.0) < 1e-5
+    with_fp = perfect.copy()
+    with_fp[0, 2] = [1, 0.95, 50, 50, 60, 60]  # confident miss, class 1
+    m = run_map(with_fp)
+    assert 0.4 < m < 1.0, m
