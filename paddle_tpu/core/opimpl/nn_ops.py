@@ -198,6 +198,10 @@ def _layer_norm(env, op):
     eps = op.attr("epsilon", 1e-5)
     begin = op.attr("begin_norm_axis", 1)
     axes = tuple(range(begin, x.ndim))
+    # stats in fp32 even for bf16-resident activations (AMP); Y stored in
+    # the input dtype so the residual stream stays bf16 (cf. batch_norm)
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
     mean = jnp.mean(x, axis=axes, keepdims=True)
     var = jnp.var(x, axis=axes, keepdims=True)
     norm = (x - mean) * jax.lax.rsqrt(var + eps)
@@ -206,7 +210,7 @@ def _layer_norm(env, op):
         norm = norm * scale.reshape(bshape)
     if bias is not None:
         norm = norm + bias.reshape(bshape)
-    put(env, op.output("Y"), norm)
+    put(env, op.output("Y"), norm.astype(in_dtype))
     put(env, op.output("Mean"), mean.reshape(mean.shape[:begin]))
     put(env, op.output("Variance"), var.reshape(var.shape[:begin]))
 
@@ -270,14 +274,17 @@ def _dropout(env, op):
 
 @register("softmax")
 def _softmax(env, op):
-    put(env, op.output("Out"),
-        jax.nn.softmax(get(env, op.input("X")), axis=op.attr("axis", -1)))
+    x = get(env, op.input("X"))
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=op.attr("axis", -1))
+    put(env, op.output("Out"), out.astype(x.dtype))
 
 
 @register("log_softmax")
 def _log_softmax(env, op):
-    put(env, op.output("Out"),
-        jax.nn.log_softmax(get(env, op.input("X")), axis=op.attr("axis", -1)))
+    x = get(env, op.input("X"))
+    out = jax.nn.log_softmax(x.astype(jnp.float32),
+                             axis=op.attr("axis", -1))
+    put(env, op.output("Out"), out.astype(x.dtype))
 
 
 # ---------------- losses ----------------
@@ -306,7 +313,8 @@ def _cross_entropy(env, op):
 def _softmax_with_cross_entropy(env, op):
     logits = get(env, op.input("Logits"))
     label = get(env, op.input("Label"))
-    log_p = jax.nn.log_softmax(logits, axis=-1)
+    # fp32 softmax stats for bf16-resident logits (AMP)
+    log_p = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     if op.attr("soft_label", False):
         loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
     else:
