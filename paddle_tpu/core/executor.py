@@ -214,8 +214,9 @@ class Executor:
         if program is None:
             program = framework.default_main_program()
         if check_nan_inf is None:
-            flag = os.environ.get("FLAGS_check_nan_inf", "").strip().lower()
-            check_nan_inf = flag in ("1", "true", "yes", "on")
+            from .op_registry import env_flag
+
+            check_nan_inf = env_flag("FLAGS_check_nan_inf")
         if check_nan_inf:
             if isinstance(program, CompiledProgram):
                 warnings.warn("check_nan_inf runs op-by-op and only "

@@ -692,7 +692,11 @@ def _ctc_align(env, op):
     pad_val = op.attr("padding_value", 0)
     b, t = x.shape
     pos = jnp.arange(t)[None, :]
-    valid = pos < lens.reshape(-1, 1)
+    if lens is None:  # optional: default to full time dimension
+        valid = jnp.ones((b, t), bool)
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        valid = pos < lens.reshape(-1, 1)
     first = pos == 0
     keep = valid & (x != blank) & (first | (x != jnp.roll(x, 1, axis=1)))
     # stable front-compaction: order by (dropped, position)
@@ -779,7 +783,10 @@ def _detection_map(env, op):
             ap = jnp.sum(precision * d_rec * is_c)
         return jnp.where(n_gt > 0, ap, jnp.nan)
 
-    aps = jax.vmap(run_class)(jnp.arange(1, class_num))  # skip background 0
+    bg = op.attr("background_label", 0)
+    classes = jnp.asarray([c for c in range(class_num) if c != bg],
+                          jnp.int32)  # bg=-1 evaluates every class
+    aps = jax.vmap(run_class)(classes)
     present = ~jnp.isnan(aps)
     m_ap = jnp.sum(jnp.where(present, aps, 0.0)) / jnp.maximum(
         jnp.sum(present.astype(jnp.float32)), 1.0)

@@ -14,7 +14,8 @@ __all__ = [
     "modified_huber_loss", "teacher_student_sigmoid_loss",
     "squared_l2_distance", "unpool", "max_pool2d_with_index", "psroi_pool",
     "spp", "sequence_expand_as", "sequence_reshape", "sequence_scatter",
-    "random_crop", "chunk_eval",
+    "random_crop", "chunk_eval", "ctc_greedy_decoder",
+    "detection_map",
 ]
 
 
@@ -284,3 +285,39 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types, seq_length,
     return (outs["Precision"], outs["Recall"], outs["F1-Score"],
             outs["NumInferChunks"], outs["NumLabelChunks"],
             outs["NumCorrectChunks"])
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, padding_value=0,
+                       name=None):
+    """Greedy CTC decode: argmax over classes then ``ctc_align`` merge/
+    de-blank (ref ``layers/nn.py`` ctc_greedy_decoder over LoD; padded
+    re-design returns ([B, T] ids front-compacted, [B] lengths)."""
+    from . import nn
+
+    helper = LayerHelper("ctc_greedy_decoder", name=name)
+    ids = nn.argmax(input, axis=-1)
+    out = helper.create_variable_for_type_inference(dtype="int32")
+    out_len = helper.create_variable_for_type_inference(dtype="int32")
+    inputs = {"Input": ids}
+    if input_length is not None:
+        inputs["InputLength"] = input_length
+    helper.append_op("ctc_align", inputs,
+                     {"Output": out, "OutputLength": out_len},
+                     {"blank": blank, "padding_value": padding_value})
+    return out, out_len
+
+
+def detection_map(detect_res, gt_label, gt_box, class_num,
+                  background_label=0, overlap_threshold=0.5,
+                  ap_version="integral", name=None):
+    helper = LayerHelper("detection_map", name=name)
+    out = helper.create_variable_for_type_inference(dtype="float32",
+                                                    shape=())
+    helper.append_op("detection_map",
+                     {"DetectRes": detect_res, "GtLabel": gt_label,
+                      "GtBox": gt_box},
+                     {"MAP": out},
+                     {"class_num": class_num, "ap_type": ap_version,
+                      "overlap_threshold": overlap_threshold,
+                      "background_label": background_label})
+    return out

@@ -5,7 +5,8 @@ EditDistance, Auc, DetectionMAP)."""
 import numpy as np
 
 __all__ = ["MetricBase", "CompositeMetric", "Precision", "Recall",
-           "Accuracy", "EditDistance", "Auc"]
+           "Accuracy", "EditDistance", "Auc", "ChunkEvaluator",
+           "DetectionMAP"]
 
 
 class MetricBase:
@@ -136,3 +137,46 @@ class Auc(MetricBase):
         fp_prev = np.concatenate([[0.0], fp[:-1]])
         area = np.sum((fp - fp_prev) * (tp + tp_prev) / 2.0)
         return float(area / (tot_pos * tot_neg))
+
+
+class ChunkEvaluator(MetricBase):
+    """Streaming chunk P/R/F1 (ref ``metrics.py`` ChunkEvaluator): feed the
+    three counts emitted by ``layers.chunk_eval`` each batch."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.num_infer_chunks = 0
+        self.num_label_chunks = 0
+        self.num_correct_chunks = 0
+
+    def update(self, num_infer_chunks, num_label_chunks,
+               num_correct_chunks):
+        self.num_infer_chunks += int(num_infer_chunks)
+        self.num_label_chunks += int(num_label_chunks)
+        self.num_correct_chunks += int(num_correct_chunks)
+
+    def eval(self):
+        precision = (self.num_correct_chunks /
+                     self.num_infer_chunks) if self.num_infer_chunks else 0.0
+        recall = (self.num_correct_chunks /
+                  self.num_label_chunks) if self.num_label_chunks else 0.0
+        f1 = (2 * precision * recall / (precision + recall)
+              if precision + recall else 0.0)
+        return precision, recall, f1
+
+
+class DetectionMAP(MetricBase):
+    """Streaming mean of per-batch mAP values from the ``detection_map``
+    op (ref ``metrics.py`` DetectionMAP's accumulate mode)."""
+
+    def __init__(self, name=None):
+        super().__init__(name)
+        self.total = 0.0
+        self.weight = 0
+
+    def update(self, value, weight=1):
+        self.total += float(value) * int(weight)
+        self.weight += int(weight)
+
+    def eval(self):
+        return self.total / self.weight if self.weight else 0.0

@@ -335,3 +335,66 @@ def test_detection_map(rng):
     with_fp[0, 2] = [1, 0.95, 50, 50, 60, 60]  # confident miss, class 1
     m = run_map(with_fp)
     assert 0.4 < m < 1.0, m
+
+
+def test_ctc_greedy_decoder_and_metrics(rng):
+    """End-to-end: logits -> ctc_greedy_decoder; metric classes stream."""
+    logits = np.full((1, 5, 4), -5.0, dtype="f4")
+    for t, c in enumerate([1, 1, 0, 2, 0]):  # blank=0
+        logits[0, t, c] = 5.0
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        x = fluid.layers.data("x", shape=[5, 4])
+        ln = fluid.layers.data("ln", shape=[], dtype="int64")
+        ids, lens = fluid.layers.ctc_greedy_decoder(x, blank=0,
+                                                    input_length=ln)
+        exe = fluid.Executor(fluid.CPUPlace())
+        got, gl = exe.run(main, feed={"x": logits,
+                                      "ln": np.array([5], "int64")},
+                          fetch_list=[ids, lens])
+    np.testing.assert_array_equal(got[0, :2], [1, 2])
+    assert gl[0] == 2
+
+    ce = fluid.metrics.ChunkEvaluator()
+    ce.update(10, 8, 6)
+    p, r, f1 = ce.eval()
+    assert abs(p - 0.6) < 1e-9 and abs(r - 0.75) < 1e-9
+    dm = fluid.metrics.DetectionMAP()
+    dm.update(0.5, 2)
+    dm.update(1.0, 2)
+    assert abs(dm.eval() - 0.75) < 1e-9
+
+
+def test_amp_matches_f32_convergence(rng):
+    """bf16-resident AMP must track the f32 loss trajectory closely."""
+    xs = rng.randn(16, 16).astype("f4")
+    w = rng.randn(16, 1).astype("f4")
+    ys = xs @ w + 0.1 * rng.randn(16, 1).astype("f4")
+
+    def run(amp):
+        fluid.unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 12
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            x = fluid.layers.data("x", shape=[16])
+            y = fluid.layers.data("y", shape=[1])
+            h = fluid.layers.fc(x, size=32, act="tanh")
+            h = fluid.layers.layer_norm(h)
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(h, size=1), y))
+            opt = fluid.optimizer.Adam(0.01)
+            if amp:
+                opt = fluid.amp.decorate(opt)
+            opt.minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            return [float(exe.run(main, feed={"x": xs, "y": ys},
+                                  fetch_list=[loss])[0])
+                    for _ in range(15)]
+
+    f32 = run(False)
+    bf16 = run(True)
+    # same downward trajectory within bf16 tolerance
+    assert bf16[-1] < 0.5 * bf16[0]
+    np.testing.assert_allclose(bf16, f32, rtol=0.15, atol=0.02)
