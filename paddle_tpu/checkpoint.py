@@ -145,20 +145,53 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
             for k, (idx, arr) in enumerate(pieces):
                 sharded["%s@%d" % (v.name, k)] = arr
 
-    # next version number (process 0 decides; others follow the marker the
-    # caller coordinates — single-host multi-device writes happen in one
-    # process anyway)
+    # next version number. In multi-process mode every process must land in
+    # the SAME version dir without any RPC plane: each process scanning its
+    # own listdir races (a desynchronized process would write shards into a
+    # different dir -> torn checkpoint found only at load). Derive the
+    # version from the caller's global step instead — deterministic on
+    # every process by construction.
     os.makedirs(checkpoint_dir, exist_ok=True)
-    existing = [int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)
-                if d.startswith("checkpoint_") and
-                d.split("_")[1].isdigit()]
-    version = (max(existing) + 1) if existing else 0
+    run_id = None
+    if nproc > 1:
+        step = (extra_meta or {}).get("step")
+        if step is None:
+            raise ValueError(
+                "multi-process save_checkpoint needs a version shared by "
+                "all processes: pass extra_meta={'step': <global step>} "
+                "(every process saves at the same step) so they all write "
+                "into the same checkpoint_<step> directory")
+        version = int(step)
+        # a save-run fingerprint shared by every process: a rollback resume
+        # can REUSE a step-derived version dir from an abandoned timeline,
+        # and a preemption mid-save would otherwise leave same-numbered
+        # shard files from two different runs that merge silently at load.
+        # Process 0's random token is broadcast over the existing jax
+        # collective plane (no extra RPC machinery).
+        try:
+            import secrets
+
+            from jax.experimental import multihost_utils
+            import jax.numpy as jnp
+
+            # 31-bit token: jax canonicalizes int64->int32 without x64,
+            # and a wider value would OverflowError into the fallback
+            token = jnp.asarray(secrets.randbits(31), jnp.uint32)
+            run_id = int(multihost_utils.broadcast_one_to_all(token))
+        except Exception:
+            run_id = None  # degraded: load falls back on coverage checks
+    else:
+        existing = [int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)
+                    if d.startswith("checkpoint_") and
+                    d.split("_")[1].isdigit()]
+        version = (max(existing) + 1) if existing else 0
     vdir = os.path.join(checkpoint_dir, "checkpoint_%d" % version)
     os.makedirs(vdir, exist_ok=True)
 
     manifest = {
         "version": version,
         "nproc": nproc,
+        "run_id": run_id,
         "vars": manifest_vars,
         "rng": rng_meta,
         "extra": extra_meta or {},
@@ -190,7 +223,10 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
                     f.write("checkpoint_%d" % version)
                 os.replace(os.path.join(checkpoint_dir, "latest.tmp"),
                            os.path.join(checkpoint_dir, "latest"))
-                _trim(checkpoint_dir, max_num_checkpoints)
+                # grace only matters when other processes write shards
+                # concurrently; a single process serializes its writers
+                _trim(checkpoint_dir, max_num_checkpoints,
+                      grace_seconds=60.0 if nproc > 1 else 0.0)
             else:
                 with open(os.path.join(
                         vdir, "manifest_p%d.json" % proc), "w") as f:
@@ -221,15 +257,32 @@ def _savez_atomic(path, arrays):
     _atomic_savez(path, arrays)
 
 
-def _trim(checkpoint_dir, keep):
+def _trim(checkpoint_dir, keep, grace_seconds=60.0):
+    """Keep the ``keep`` most RECENTLY WRITTEN versions (mtime, not version
+    number: step-derived versions are not monotonic across a rollback
+    resume, and retention by number would delete the fresh post-rollback
+    saves while preserving stale dirs from the abandoned timeline). Never
+    remove one touched in the last ``grace_seconds`` — a straggler process
+    may still be writing shard files into it (dir mtime updates on every
+    file creation); skipped dirs get trimmed by a later save instead."""
     if not keep or keep <= 0:
         return
-    versions = sorted(
-        int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)
-        if d.startswith("checkpoint_") and d.split("_")[1].isdigit())
-    for v in versions[:-keep]:
-        shutil.rmtree(os.path.join(checkpoint_dir, "checkpoint_%d" % v),
-                      ignore_errors=True)
+    import time
+
+    dirs = []
+    for d in os.listdir(checkpoint_dir):
+        if d.startswith("checkpoint_") and d.split("_")[1].isdigit():
+            path = os.path.join(checkpoint_dir, d)
+            try:
+                dirs.append((os.path.getmtime(path), path))
+            except OSError:
+                continue
+    dirs.sort()  # oldest write first
+    now = time.time()
+    for mtime, path in dirs[:-keep]:
+        if grace_seconds > 0 and now - mtime < grace_seconds:
+            continue
+        shutil.rmtree(path, ignore_errors=True)
 
 
 def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
@@ -256,15 +309,31 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
         os.path.exists(repl_path) else {}
 
     # per-process piece indices: primary manifest (p0) + the secondary
-    # manifests other processes wrote next to their shard files
+    # manifests other processes wrote next to their shard files. Files from
+    # processes >= the saving run's nproc are leftovers of an EARLIER run
+    # that reused this version dir (e.g. a relaunch with fewer processes
+    # saving at the same step) — merging them would reassemble vars from a
+    # mix of runs, so they are skipped.
+    nproc_saved = int(manifest.get("nproc", 1))
+    run_expect = manifest.get("run_id")
     piece_index = {}  # var name -> [(proc, [idx, ...])]
     for pf in [os.path.join(vdir, _MANIFEST)] + [
             os.path.join(vdir, f) for f in sorted(os.listdir(vdir))
             if f.startswith("manifest_p")]:
         with open(pf) as f:
             m = json.load(f)
+        # a secondary manifest from a different save-run (abandoned
+        # timeline reusing this step's dir): its shards are not this
+        # checkpoint's — skip them; the coverage mask below then fails
+        # the load loudly and resume falls back to an older version.
+        # Each process writes its shards BEFORE its manifest, so a
+        # matching run_id vouches for the shard file next to it.
+        if m.get("run_id") != run_expect:
+            continue
         for name, meta in m["vars"].items():
             for pkey, idxs in meta.get("pieces", {}).items():
+                if int(pkey[1:]) >= nproc_saved:
+                    continue
                 piece_index.setdefault(name, []).append(
                     (int(pkey[1:]), idxs))
 
@@ -353,10 +422,26 @@ def resume_or_init(executor, startup_program, checkpoint_dir,
     executor.run(startup_program, scope=scope)
     if not os.path.isdir(checkpoint_dir):
         return None
-    versions = sorted(
-        (int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)
-         if d.startswith("checkpoint_") and d.split("_")[1].isdigit()),
-        reverse=True)
+    # candidate order: the 'latest' marker first, then the rest by WRITE
+    # RECENCY (step-derived versions are not monotonic across a rollback
+    # resume, so the highest number may be a stale abandoned-timeline dir)
+    by_mtime = []
+    for d in os.listdir(checkpoint_dir):
+        if d.startswith("checkpoint_") and d.split("_")[1].isdigit():
+            try:
+                mt = os.path.getmtime(os.path.join(checkpoint_dir, d))
+            except OSError:
+                continue
+            by_mtime.append((mt, int(d.split("_")[1])))
+    versions = [v for _, v in sorted(by_mtime, reverse=True)]
+    try:
+        with open(os.path.join(checkpoint_dir, "latest")) as f:
+            marked = int(f.read().strip().split("_")[1])
+        if marked in versions:
+            versions.remove(marked)
+            versions.insert(0, marked)
+    except (OSError, ValueError, IndexError):
+        pass
     if not versions:
         return None
     # a preemption can land mid-save (e.g. one process's shard file never

@@ -515,9 +515,16 @@ def _add_position_encoding(env, op):
     alpha = op.attr("alpha", 1.0)
     beta = op.attr("beta", 1.0)
     b, t, d = x.shape
+    if d % 2:
+        raise ValueError(
+            "add_position_encoding requires an even encode size; got %d "
+            "(ref enforces enc_size %% 2 == 0)" % d)
+    half = d // 2
     pos = jnp.arange(t, dtype=jnp.float32)[:, None]
-    i = jnp.arange(d // 2, dtype=jnp.float32)[None, :]
-    angle = pos / jnp.power(10000.0, 2.0 * i / d)
+    i = jnp.arange(half, dtype=jnp.float32)[None, :]
+    # ref kernel's frequency exponent is k/(half_size-1), NOT 2k/d
+    denom = float(max(half - 1, 1))
+    angle = pos / jnp.power(10000.0, i / denom)
     pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
     put(env, op.output("Out"), alpha * x + beta * pe[None])
 

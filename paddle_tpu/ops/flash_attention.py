@@ -567,6 +567,10 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
     # Mosaic-friendly head dims only; anything else degrades to the
     # reference path instead of a lowering error
     pallas_ok = pallas_ok and d % 8 == 0
+    # the kernels anchor the causal diagonal at position 0 (q_pos >= k_pos)
+    # while mha_reference anchors it at the sequence END (tril k=t_k-t_q);
+    # for t_q != t_k they disagree, so only the square case takes the kernel
+    pallas_ok = pallas_ok and (not causal or t == t_k)
     # short sequences: XLA's fused attention beats the kernel's grid
     # overhead (measured: BERT T=128 -14% under the kernel, transformer
     # T=256 +10%); cross-over sits between

@@ -126,26 +126,41 @@ def _split_stages(fwd_ops, boundaries):
     return stages
 
 
-def _crossing_sets(stages, base_names):
-    """For each boundary s (between stage s and s+1): the vars produced in
-    stages <= s and consumed in stages > s. Feeds/params (base_names) are
-    replicated and never carried."""
-    produced_by = {}
-    for s, ops in enumerate(stages):
+def _crossing_sets(stages):
+    """Per-consumer reaching definitions: for each boundary s, the vars
+    whose value at the end of stage s is needed by a later stage.
+
+    A read in stage s2 is *upward-exposed* when it happens before any write
+    of the same name inside s2 (op program order); its reaching definition
+    is the latest earlier stage ``wd`` that writes the name, and the var
+    must ride the carry across every boundary wd..s2-1 (intermediate stages
+    pass it through: unpack puts it in their local env, pack re-emits it).
+    Because the carry at boundary b always holds the latest write <= b,
+    non-SSA programs (a name shadowed by a later stage, or a feed/param
+    overwritten by a stage and read downstream) get correct reaching-
+    definition semantics instead of silently reading a stale step-start
+    value. Names never written by any stage are feeds/params/setup values:
+    replicated, never carried."""
+    writes, ue_reads = [], []
+    for ops in stages:
+        w, r = set(), set()
         for op in ops:
+            for n in op.input_arg_names:
+                if n not in w:
+                    r.add(n)
             for n in op.output_arg_names:
-                produced_by[n] = s
-    crossings = []
-    for s in range(len(stages) - 1):
-        live = set()
-        for s2 in range(s + 1, len(stages)):
-            for op in stages[s2]:
-                for n in op.input_arg_names:
-                    ps = produced_by.get(n)
-                    if ps is not None and ps <= s and n not in base_names:
-                        live.add(n)
-        crossings.append(sorted(live))
-    return crossings
+                w.add(n)
+        writes.append(w)
+        ue_reads.append(r)
+    crossings = [set() for _ in range(len(stages) - 1)]
+    for s2 in range(1, len(stages)):
+        for n in ue_reads[s2]:
+            defs = [w for w in range(s2) if n in writes[w]]
+            if not defs:
+                continue  # feed/param/setup value: replicated everywhere
+            for b in range(max(defs), s2):
+                crossings[b].add(n)
+    return [sorted(c) for c in crossings]
 
 
 def pipeline_program_loss(base_env, fwd_ops, loss_name, cfg, run_op,
@@ -219,8 +234,7 @@ def pipeline_program_loss(base_env, fwd_ops, loss_name, cfg, run_op,
     if not all(stages):
         raise ValueError("a pipeline stage contains only batch-independent "
                          "setup ops; move the boundary")
-    base_names |= const_names
-    crossings = _crossing_sets(stages, base_names)
+    crossings = _crossing_sets(stages)
 
     # carry layout per boundary: (name, mb_shape, dtype, offset, size).
     # shapes come from the already-traced outer forward (shape_env);

@@ -172,3 +172,53 @@ def test_checkpoint_refuses_missing_shards(tmp_path):
         os.remove(os.path.join(w.path, "shards_p0.npz"))
         with pytest.raises(IOError, match="missing"):
             fluid.io.load_checkpoint(exe, ckpt, main_program=main)
+
+
+def test_load_ignores_stale_higher_proc_files(tmp_path):
+    """A relaunch with fewer processes reusing a step-derived version dir
+    must not merge the previous run's leftover manifest_p<n>/shards_p<n>
+    files (n >= the saving run's nproc) into the restore."""
+    import json
+
+    ckpt = str(tmp_path / "s")
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        loss = _build()
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        w = fluid.io.save_checkpoint(exe, ckpt, main_program=main,
+                                     async_write=False)
+        want = scope.numpy("w1")
+        # plant a stale manifest claiming a bogus piece from process 7
+        stale = {"version": 0, "nproc": 8, "vars": {
+            "w1": {"kind": "sharded", "shape": [16, 32],
+                              "dtype": "float32",
+                              "pieces": {"p7": [[[0, 16], [0, 32]]]}}},
+            "rng": None, "extra": {}}
+        with open(os.path.join(w.path, "manifest_p7.json"), "w") as f:
+            json.dump(stale, f)
+        exe.run(startup)  # clobber
+        fluid.io.load_checkpoint(exe, ckpt, main_program=main)
+        np.testing.assert_allclose(scope.numpy("w1"), want)
+
+
+def test_trim_keeps_most_recently_written(tmp_path):
+    """Retention is by write recency, not version number: after a rollback
+    resume, fresh low-numbered saves must survive stale higher ones."""
+    from paddle_tpu.checkpoint import _trim
+
+    ckpt = tmp_path / "t"
+    ckpt.mkdir()
+    for name in ["checkpoint_2000", "checkpoint_3000", "checkpoint_1100"]:
+        (ckpt / name).mkdir()
+    ages = {"checkpoint_2000": 900, "checkpoint_3000": 800,
+            "checkpoint_1100": 10}
+    import time
+    now = time.time()
+    for name, age in ages.items():
+        os.utime(ckpt / name, (now - age, now - age))
+    _trim(str(ckpt), keep=2, grace_seconds=60.0)
+    kept = sorted(d for d in os.listdir(ckpt))
+    assert kept == ["checkpoint_1100", "checkpoint_3000"], kept

@@ -198,7 +198,8 @@ def test_add_position_encoding_and_bilinear(rng):
     x = rng.randn(2, 5, 8).astype("float32")
     pos = np.arange(5, dtype="float32")[:, None]
     i = np.arange(4, dtype="float32")[None, :]
-    angle = pos / np.power(10000.0, 2 * i / 8)
+    # ref add_position_encoding_op.h: exponent is k/(half_size-1)
+    angle = pos / np.power(10000.0, i / 3.0)
     pe = np.concatenate([np.sin(angle), np.cos(angle)], axis=1)
     check_output("add_position_encoding", {"X": x},
                  {"Out": (0.5 * x + 2.0 * pe[None]).astype("f4")},

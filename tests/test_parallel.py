@@ -334,3 +334,35 @@ def test_zero_reduce_strategy_shards_optimizer_state():
     from jax.sharding import PartitionSpec as P
     assert acc_all.sharding.is_fully_replicated
     assert acc_zero.sharding.spec == P("dp", None)
+
+
+def test_pipeline_crossing_sets_reaching_defs():
+    """Non-SSA programs: a name shadowed in a later stage must be carried
+    with per-consumer reaching-definition semantics (ADVICE r2 #1)."""
+    from paddle_tpu.parallel.pipeline import _crossing_sets
+
+    class Op:
+        def __init__(self, ins, outs):
+            self.input_arg_names = ins
+            self.output_arg_names = outs
+
+    # stage0 writes h; stage1 reads h (old value) AND shadows h; stage2
+    # reads h (new value). h must cross boundary 0 (for stage1's read) and
+    # boundary 1 (stage1's shadowing write reaches stage2).
+    stages = [[Op(["x"], ["h"])],
+              [Op(["h"], ["t"]), Op(["t"], ["h"])],
+              [Op(["h"], ["loss"])]]
+    cross = _crossing_sets(stages)
+    assert cross == [["h"], ["h"]]
+
+    # a feed/param name overwritten by stage0 and read by stage2 must be
+    # carried (not silently re-read from the replicated step-start value)
+    stages = [[Op(["w"], ["w"])], [Op(["x"], ["u"])], [Op(["w", "u"], ["l"])]]
+    cross = _crossing_sets(stages)
+    assert cross == [["w"], ["u", "w"]]
+
+    # read-after-local-write is NOT upward-exposed: no carry needed
+    stages = [[Op(["x"], ["a"])], [Op(["x"], ["h"]), Op(["h"], ["b"])],
+              [Op(["a", "b"], ["l"])]]
+    cross = _crossing_sets(stages)
+    assert cross == [["a"], ["a", "b"]]
