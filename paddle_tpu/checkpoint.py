@@ -179,6 +179,13 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
             token = jnp.asarray(secrets.randbits(31), jnp.uint32)
             run_id = int(multihost_utils.broadcast_one_to_all(token))
         except Exception:
+            # Degrade to run_id=None ONLY when the collective plane is
+            # absent altogether (then every process fails identically and
+            # the manifests stay consistent). With a live multi-process
+            # plane, a PARTIAL failure would leave mismatched manifests
+            # that make every save of the run unloadable — raise instead.
+            if jax.process_count() > 1:
+                raise
             run_id = None  # degraded: load falls back on coverage checks
     else:
         existing = [int(d.split("_")[1]) for d in os.listdir(checkpoint_dir)

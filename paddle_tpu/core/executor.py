@@ -162,15 +162,28 @@ def _make_rng_key(seed):
     return jax.random.key(seed, impl=choice)
 
 
-def build_step_fn(program, fetch_names, persist_names, pp_cfg=None):
+def build_step_fn(program, fetch_names, persist_names, pp_cfg=None,
+                  fuse_opt=True):
     """Trace a program's global block into one pure function
     ``(state, feed, rng) -> (fetches, new_state, rng')`` — the unit the
     Executor jits, ``__graft_entry__`` exposes, and bench.py times.
     ``pp_cfg`` routes the autodiff replay through the pipeline engine
-    (see ``parallel/pipeline.py``)."""
+    (see ``parallel/pipeline.py``). ``fuse_opt`` batches dense optimizer
+    updates into one flattened kernel (see ``opt_fusion.py``); the mesh
+    path disables it to keep per-tensor GSPMD sharding propagation."""
+    from .op_registry import env_flag
+    from .opt_fusion import plan_opt_fusion, run_fused_group
+
     ops = list(program.global_block().ops)
     persist_set = set(persist_names)
     amp = bool(getattr(program, "_amp_bf16", False))
+    # measured on-chip (NOTES_r3.md): per-param updates cost ~8us each in
+    # isolation — the profile's ~100us/update is scheduling stall, which
+    # concat-batching makes WORSE (796 dynamic-update-slices). Keep the
+    # batcher opt-in for experiments.
+    plan, skip = ({}, set())
+    if fuse_opt and env_flag("PADDLE_TPU_FUSED_OPT"):
+        plan, skip = plan_opt_fusion(ops)
 
     def step(state, feed, rng):
         from .op_registry import AMP, PP_KEY
@@ -189,7 +202,13 @@ def build_step_fn(program, fetch_names, persist_names, pp_cfg=None):
         prev_amp = AMP.enabled
         AMP.enabled = amp  # trace-time flag: fwd + autodiff replay
         try:
-            for op in ops:
+            for i, op in enumerate(ops):
+                if i in skip:
+                    continue
+                if i in plan:
+                    with jax.named_scope("fused_" + op.type):
+                        run_fused_group(env, plan[i])
+                    continue
                 run_op(env, op)
         finally:
             AMP.enabled = prev_amp
@@ -517,7 +536,7 @@ class Executor:
                       "boundaries": list(pp_boundaries),
                       "n_micro": pp_nmicro, "feed_names": list(feed_names)}
         step = build_step_fn(program, fetch_names, persist_names,
-                             pp_cfg=pp_cfg)
+                             pp_cfg=pp_cfg, fuse_opt=mesh is None)
         donate = (0,)
         if mesh is None:
             return jax.jit(step, donate_argnums=donate)

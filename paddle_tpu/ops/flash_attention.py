@@ -502,6 +502,312 @@ def _flash_bwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate,
 
 
 # ---------------------------------------------------------------------------
+# dense short-sequence kernels — packed [B, T, H*D] layout, whole-sequence
+# blocks resident in VMEM
+# ---------------------------------------------------------------------------
+#
+# For t_k up to ~1k the per-head problem fits VMEM outright, so the online-
+# softmax streaming machinery above only adds grid/loop overhead (profiled at
+# ~5% MXU on transformer-base T=256), and the [B,T,H*D]->[B*H,T,D] head split
+# forces XLA transpose copies around the custom call (~7 per attention site).
+# These kernels instead take the packed layout the projection matmuls
+# naturally produce, loop the heads inside one grid step (static lane slices,
+# no HBM relayout), and compute softmax in one shot per head. One grid step
+# per batch element amortizes grid overhead ~H*n_block times better.
+
+def _dense_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+                      lse_ref, *, num_heads, causal, scale, q_len, kv_len,
+                      dropout_rate):
+    t_pad, hd = q_ref.shape[1], q_ref.shape[2]
+    tk_pad = k_ref.shape[1]
+    d = hd // num_heads
+    from jax.experimental import pallas as pl
+
+    b_idx = pl.program_id(0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 1)
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 0)
+    mask = k_pos < kv_len
+    if causal:
+        # end-anchored diagonal (matches mha_reference for t_q != t_k)
+        mask = mask & (k_pos <= q_pos + (kv_len - q_len))
+    bias = None
+    if bias_ref is not None:
+        bias = bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+
+    for h in range(num_heads):
+        sl = pl.dslice(h * d, d)
+        qh = q_ref[0, :, sl]
+        kh = k_ref[0, :, sl]
+        vh = v_ref[0, :, sl]
+        s = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [t, tk]
+        if bias is not None:
+            s = s + bias
+        s = jnp.where(mask, s, -jnp.inf)
+        m = jnp.max(s, axis=1)
+        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
+        p = jnp.where(mask, jnp.exp(s - m_safe[:, None]), 0.0)
+        l = jnp.maximum(jnp.sum(p, axis=1), 1e-30)
+        p_use = p
+        if dropout_rate > 0.0:
+            keep = _dropout_keep((t_pad, tk_pad), dropout_rate,
+                                 seed_ref[0, 0],
+                                 (b_idx * num_heads + h, 0, 0))
+            p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+        o_h = jax.lax.dot_general(
+            p_use.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) / l[:, None]
+        o_ref[0, :, sl] = o_h.astype(o_ref.dtype)
+        lse = jnp.where(jnp.isfinite(m), m + jnp.log(l), -jnp.inf)
+        lse_ref[0, h, :] = lse.astype(jnp.float32)
+
+
+def _dense_bwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+                      out_ref, lse_ref, dq_ref, dk_ref, dv_ref, db_ref, *,
+                      num_heads, causal, scale, q_len, kv_len, dropout_rate):
+    t_pad, hd = q_ref.shape[1], q_ref.shape[2]
+    tk_pad = k_ref.shape[1]
+    d = hd // num_heads
+    from jax.experimental import pallas as pl
+
+    b_idx = pl.program_id(0)
+    k_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 1)
+    q_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 0)
+    mask = (k_pos < kv_len) & (q_pos < q_len)
+    if causal:
+        mask = mask & (k_pos <= q_pos + (kv_len - q_len))
+    bias = None
+    if bias_ref is not None:
+        bias = bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+    db_acc = jnp.zeros((tk_pad,), jnp.float32) if db_ref is not None else None
+
+    for h in range(num_heads):
+        sl = pl.dslice(h * d, d)
+        qh = q_ref[0, :, sl]
+        kh = k_ref[0, :, sl]
+        vh = v_ref[0, :, sl]
+        do = do_ref[0, :, sl].astype(jnp.float32)
+        o = out_ref[0, :, sl].astype(jnp.float32)
+        lse = lse_ref[0, h, :]
+        delta = jnp.sum(do * o, axis=1)  # [t]
+        lse_okf = jnp.isfinite(lse).astype(jnp.float32)
+        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+        s = jax.lax.dot_general(
+            qh, kh, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if bias is not None:
+            s = s + bias
+        p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
+                      0.0) * lse_okf[:, None]
+        dp = jax.lax.dot_general(
+            do, vh.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [t, tk]
+        p_drop = p
+        if dropout_rate > 0.0:
+            keep = _dropout_keep((t_pad, tk_pad), dropout_rate,
+                                 seed_ref[0, 0],
+                                 (b_idx * num_heads + h, 0, 0))
+            inv = 1.0 / (1.0 - dropout_rate)
+            p_drop = jnp.where(keep, p * inv, 0.0)
+            dp = jnp.where(keep, dp * inv, 0.0)
+        ds = p * (dp - delta[:, None])  # [t, tk]
+        dq_ref[0, :, sl] = (jax.lax.dot_general(
+            ds.astype(kh.dtype), kh, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale).astype(dq_ref.dtype)
+        # (0),(0)-contracting dots relayout their operands; Mosaic only
+        # supports that for 32-bit types, so run them in f32
+        dk_ref[0, :, sl] = (jax.lax.dot_general(
+            ds, qh.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
+        dv_ref[0, :, sl] = jax.lax.dot_general(
+            p_drop, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+        if db_acc is not None:
+            db_acc = db_acc + jnp.sum(ds, axis=0)
+    if db_ref is not None:
+        db_ref[0, 0, :] = db_acc
+
+
+def _pad_last(x, m):
+    r = (-x.shape[1]) % m
+    return jnp.pad(x, ((0, 0), (0, r), (0, 0))) if r else x
+
+
+def _dense_fwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
+                    dropout_rate):
+    """q,k,v: packed [B, T, H*D]; bias [B, Tk] or None.
+    Returns (out [B, T, H*D], lse [B, H, T_pad])."""
+    from jax.experimental import pallas as pl
+
+    b, t, hd = q.shape
+    t_k = k.shape[1]
+    m = 8 if _INTERPRET else 128
+    qp = _pad_last(q, m)
+    kp, vp = _pad_last(k, m), _pad_last(v, m)
+    t_pad, tk_pad = qp.shape[1], kp.shape[1]
+
+    kernel = functools.partial(
+        _dense_fwd_kernel, num_heads=num_heads, causal=causal, scale=scale,
+        q_len=t, kv_len=t_k, dropout_rate=dropout_rate)
+    in_specs = [
+        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias is not None:
+        bp = _pad_vec(bias, m)
+        in_specs.append(pl.BlockSpec((1, 8, tk_pad), lambda bi: (bi, 0, 0)))
+        args.append(jnp.broadcast_to(bp[:, None, :], (b, 8, tk_pad)))
+
+    def entry(*refs):
+        if bias is not None:
+            q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, l_ref = refs
+        else:
+            q_ref, k_ref, v_ref, s_ref, o_ref, l_ref = refs
+            b_ref = None
+        kernel(q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, l_ref)
+
+    in_specs.append(pl.BlockSpec((1, 1), lambda bi: (0, 0)))
+    args.append(jnp.asarray([[seed]], jnp.uint32))
+    nh_pad = max(num_heads, 8)  # sublane-tiled stats block
+    out, lse = pl.pallas_call(
+        entry,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
+            pl.BlockSpec((1, nh_pad, t_pad), lambda bi: (bi, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t_pad, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, nh_pad, t_pad), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(*args)
+    return out[:, :t], lse
+
+
+def _dense_bwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
+                    dropout_rate, out, lse, do):
+    from jax.experimental import pallas as pl
+
+    b, t, hd = q.shape
+    t_k = k.shape[1]
+    m = 8 if _INTERPRET else 128
+    qp, kp, vp = _pad_last(q, m), _pad_last(k, m), _pad_last(v, m)
+    dop, outp = _pad_last(do, m), _pad_last(out, m)
+    t_pad, tk_pad = qp.shape[1], kp.shape[1]
+    nh_pad = lse.shape[1]
+
+    kernel = functools.partial(
+        _dense_bwd_kernel, num_heads=num_heads, causal=causal, scale=scale,
+        q_len=t, kv_len=t_k, dropout_rate=dropout_rate)
+    in_specs = [
+        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias is not None:
+        bp = _pad_vec(bias, m)
+        in_specs.append(pl.BlockSpec((1, 8, tk_pad), lambda bi: (bi, 0, 0)))
+        args.append(jnp.broadcast_to(bp[:, None, :], (b, 8, tk_pad)))
+    in_specs.append(pl.BlockSpec((1, 1), lambda bi: (0, 0)))
+    args.append(jnp.asarray([[seed]], jnp.uint32))
+    in_specs += [
+        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, nh_pad, t_pad), lambda bi: (bi, 0, 0)),
+    ]
+    args += [dop, outp, lse]
+
+    def entry(*refs):
+        if bias is not None:
+            (q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, o_ref, l_ref,
+             dq_ref, dk_ref, dv_ref, db_ref) = refs
+        else:
+            (q_ref, k_ref, v_ref, s_ref, do_ref, o_ref, l_ref,
+             dq_ref, dk_ref, dv_ref) = refs
+            b_ref = db_ref = None
+        kernel(q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, o_ref, l_ref,
+               dq_ref, dk_ref, dv_ref, db_ref)
+
+    out_specs = [
+        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, t_pad, hd), q.dtype),
+        jax.ShapeDtypeStruct((b, tk_pad, hd), k.dtype),
+        jax.ShapeDtypeStruct((b, tk_pad, hd), v.dtype),
+    ]
+    if bias is not None:
+        out_specs.append(pl.BlockSpec((1, 8, tk_pad), lambda bi: (bi, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b, 8, tk_pad), jnp.float32))
+    res = pl.pallas_call(
+        entry,
+        grid=(b,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_INTERPRET,
+    )(*args)
+    if bias is not None:
+        dq, dk, dv, db = res
+        db = db[:, 0, :t_k]
+    else:
+        dq, dk, dv = res
+        db = None
+    return dq[:, :t], dk[:, :t_k], dv[:, :t_k], db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _dense_attention(q, k, v, bias, seed, num_heads, causal, scale,
+                     dropout_rate):
+    out, _ = _dense_fwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
+                             dropout_rate)
+    return out
+
+
+def _dense_fwd(q, k, v, bias, seed, num_heads, causal, scale, dropout_rate):
+    out, lse = _dense_fwd_impl(q, k, v, bias, seed, num_heads, causal,
+                               scale, dropout_rate)
+    return out, (q, k, v, bias, seed, out, lse)
+
+
+def _dense_bwd(num_heads, causal, scale, dropout_rate, res, g):
+    q, k, v, bias, seed, out, lse = res
+    dq, dk, dv, db = _dense_bwd_impl(q, k, v, bias, seed, num_heads, causal,
+                                     scale, dropout_rate, out, lse, g)
+    dbias = db.astype(bias.dtype) if bias is not None else None
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias, None)
+
+
+_dense_attention.defvjp(_dense_fwd, _dense_bwd)
+
+# dense path ceiling: whole [T,HD] q/k/v/do/out blocks + per-head [T,Tk]
+# f32 transients must fit the ~16 MB VMEM comfortably
+_DENSE_MAX_Q = 512
+_DENSE_MAX_KV = 1024
+_DENSE_VMEM_BUDGET = 10 * 1024 * 1024
+
+
+def _dense_fits(t, t_k, hd, esize):
+    """Conservative VMEM estimate for the dense bwd step (the larger of the
+    two): 4 q-length + 4 kv-length packed blocks plus ~4 per-head [t, tk]
+    f32 transients."""
+    t_pad = ((t + 127) // 128) * 128
+    tk_pad = ((t_k + 127) // 128) * 128
+    blocks = (4 * t_pad + 4 * tk_pad) * hd * esize
+    transients = 4 * t_pad * tk_pad * 4
+    return blocks + transients <= _DENSE_VMEM_BUDGET
+
+
+# ---------------------------------------------------------------------------
 # differentiable wrapper
 # ---------------------------------------------------------------------------
 
@@ -557,26 +863,38 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
             # lift the 2-D key form so broadcasting stays right-aligned
             ref_bias = key_bias[:, None, None, :]
 
-    def split(x, t_):
-        return x.reshape(b, t_, num_heads, d).transpose(0, 2, 1, 3)
-
-    qh, kh, vh = split(q, t), split(k, t_k), split(v, t_k)
     scale = 1.0 / math.sqrt(d)
 
     pallas_ok = _use_pallas(q) and (bias is None or key_bias is not None)
     # Mosaic-friendly head dims only; anything else degrades to the
     # reference path instead of a lowering error
     pallas_ok = pallas_ok and d % 8 == 0
-    # the kernels anchor the causal diagonal at position 0 (q_pos >= k_pos)
-    # while mha_reference anchors it at the sequence END (tril k=t_k-t_q);
-    # for t_q != t_k they disagree, so only the square case takes the kernel
-    pallas_ok = pallas_ok and (not causal or t == t_k)
-    # short sequences: XLA's fused attention beats the kernel's grid
-    # overhead (measured: BERT T=128 -14% under the kernel, transformer
-    # T=256 +10%); cross-over sits between
-    pallas_ok = pallas_ok and (_INTERPRET or t_k >= 192)
     if dropout_rate > 0.0 and (_INTERPRET or rng is None):
         pallas_ok = False  # PRNG primitives are TPU-only
+
+    if dropout_rate > 0.0 and pallas_ok:
+        seed = jax.random.randint(rng, (), 0, np.iinfo(np.int32).max,
+                                  dtype=jnp.int32).astype(jnp.uint32)
+    else:
+        seed = jnp.uint32(0)
+
+    # short sequences: whole-sequence VMEM-resident kernel on the packed
+    # layout (no head-split transposes, heads looped in-kernel)
+    if (pallas_ok and t <= _DENSE_MAX_Q and t_k <= _DENSE_MAX_KV
+            and _dense_fits(t, t_k, hd, q.dtype.itemsize)):
+        return _dense_attention(q, k, v, key_bias, seed, num_heads, causal,
+                                scale, float(dropout_rate))
+
+    def split(x, t_):
+        return x.reshape(b, t_, num_heads, d).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q, t), split(k, t_k), split(v, t_k)
+
+    # the streaming kernels anchor the causal diagonal at position 0
+    # (q_pos >= k_pos) while mha_reference anchors it at the sequence END
+    # (tril k=t_k-t_q); for t_q != t_k they disagree, so only the square
+    # case takes the kernel
+    pallas_ok = pallas_ok and (not causal or t == t_k)
 
     if not pallas_ok:
         # dropout applies to the attention weights, matching the kernels
@@ -590,11 +908,6 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
     vf = vh.reshape(b * num_heads, t_k, d)
     bf = (jnp.repeat(key_bias, num_heads, axis=0)
           if key_bias is not None else None)
-    if dropout_rate > 0.0:
-        seed = jax.random.randint(rng, (), 0, np.iinfo(np.int32).max,
-                                  dtype=jnp.int32).astype(jnp.uint32)
-    else:
-        seed = jnp.uint32(0)
     out = _flash_attention(qf, kf, vf, bf, seed, causal, scale,
                            float(dropout_rate))
     out = out.reshape(b, num_heads, t, d)

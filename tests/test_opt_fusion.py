@@ -1,0 +1,87 @@
+"""Fused ("foreach") optimizer batching parity: the trace-time batching in
+``core/opt_fusion.py`` must be bit-identical to per-op updates (same math,
+same promotion rules)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _train(opt_factory, fuse, steps=4):
+    from paddle_tpu.core import unique_name
+
+    os.environ["PADDLE_TPU_FUSED_OPT"] = "1" if fuse else ""
+    old_gen = unique_name.switch()
+    try:
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 1234
+        with fluid.program_guard(main, startup):
+            x = layers.data("x", shape=[8], dtype="float32")
+            y = layers.data("y", shape=[1], dtype="float32")
+            h = layers.fc(x, size=16, act="relu")
+            h = layers.fc(h, size=16, act="relu")
+            pred = layers.fc(h, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            opt_factory().minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        scope = fluid.Scope()
+        rng = np.random.RandomState(0)
+        feed = {"x": rng.randn(32, 8).astype(np.float32),
+                "y": rng.randn(32, 1).astype(np.float32)}
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            losses = [exe.run(main, feed=feed, fetch_list=[loss])[0]
+                      for _ in range(steps)]
+            params = {
+                p.name: scope.numpy(p.name).copy()
+                for p in main.global_block().all_parameters()}
+        return np.array(losses).ravel(), params
+    finally:
+        unique_name.switch(old_gen)
+        os.environ.pop("PADDLE_TPU_FUSED_OPT", None)
+
+
+@pytest.mark.parametrize("opt_factory", [
+    lambda: fluid.optimizer.SGD(learning_rate=0.05),
+    lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9),
+    lambda: fluid.optimizer.Momentum(learning_rate=0.05, momentum=0.9,
+                                     use_nesterov=True),
+    lambda: fluid.optimizer.Adam(learning_rate=0.01),
+], ids=["sgd", "momentum", "nesterov", "adam"])
+def test_fused_matches_per_op(opt_factory):
+    l_fused, p_fused = _train(opt_factory, fuse=True)
+    l_plain, p_plain = _train(opt_factory, fuse=False)
+    np.testing.assert_allclose(l_fused, l_plain, rtol=1e-6, atol=1e-6)
+    assert set(p_fused) == set(p_plain)
+    for name in p_fused:
+        np.testing.assert_allclose(p_fused[name], p_plain[name],
+                                   rtol=1e-6, atol=1e-6, err_msg=name)
+
+
+def test_sparse_grads_stay_unfused():
+    """Embedding with is_sparse=True must keep its scatter update (the
+    planner excludes GradRows ops) and still train."""
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[4], dtype="int64")
+        emb = layers.embedding(ids, size=(50, 8), is_sparse=True)
+        h = layers.reduce_mean(emb, dim=1)
+        pred = layers.fc(h, size=1)
+        y = layers.data("y", shape=[1], dtype="float32")
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"ids": rng.randint(0, 50, (16, 4)).astype(np.int64),
+            "y": rng.randn(16, 1).astype(np.float32)}
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = exe.run(main, feed=feed, fetch_list=[loss])[0]
+        for _ in range(10):
+            last = exe.run(main, feed=feed, fetch_list=[loss])[0]
+    assert float(last) < float(first)

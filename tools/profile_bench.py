@@ -1,0 +1,126 @@
+"""Capture a jax.profiler trace of a bench config and print the device-op
+time breakdown (top HLO ops by self time, grouped by category).
+
+Usage: python tools/profile_bench.py --model transformer [--steps 10]
+Writes the raw trace under /tmp/jaxtrace-<model> and prints a table.
+"""
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def capture(model, steps, batch=None):
+    import jax
+    import paddle_tpu as fluid
+    from bench import _build
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        spec, dbatch, metric, unit, per_example = _build(model, on_tpu)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        if os.environ.get("BENCH_AMP", "1") == "1":
+            opt = fluid.amp.decorate(opt)
+        opt.minimize(spec.loss)
+    batch = batch or int(os.environ.get("BENCH_BATCH", dbatch))
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    trace_dir = "/tmp/jaxtrace-%s" % model
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = spec.sample_batch(batch, np.random.RandomState(0))
+        feed = {k: jax.device_put(v) for k, v in feed.items()}
+        for _ in range(3):
+            loss_val, = exe.run(main_prog, feed=feed, fetch_list=[spec.loss])
+        np.asarray(loss_val)
+        jax.profiler.start_trace(trace_dir)
+        for _ in range(steps):
+            loss_val, = exe.run(main_prog, feed=feed,
+                                fetch_list=[spec.loss], return_numpy=False)
+        np.asarray(loss_val)
+        jax.profiler.stop_trace()
+    return trace_dir
+
+
+def analyze(trace_dir, steps, topk=40):
+    """Parse the xplane proto; aggregate device-op self time."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.xplane.pb")))
+    if not paths:
+        raise SystemExit("no xplane found under " + trace_dir)
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    rows = []
+    for plane in space.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        emeta = plane.event_metadata
+        smeta = plane.stat_metadata
+        for line in plane.lines:
+            for ev in line.events:
+                md = emeta.get(ev.metadata_id)
+                name = md.name if md else str(ev.metadata_id)
+                dur = ev.duration_ps / 1e12
+                stats = {}
+                for st in ev.stats:
+                    sm = smeta.get(st.metadata_id)
+                    if sm:
+                        v = (st.str_value or st.int64_value or
+                             st.uint64_value or st.double_value)
+                        stats[sm.name] = v
+                rows.append((plane.name, line.name, name, dur, stats))
+
+    # Aggregate by op name on op-level lines
+    by_line = defaultdict(float)
+    for pn, ln, name, dur, stats in rows:
+        by_line[(pn, ln)] += dur
+    print("== device lines (total s over %d steps) ==" % steps)
+    for (pn, ln), tot in sorted(by_line.items(), key=lambda kv: -kv[1]):
+        print("  %-60s %8.4f" % (pn + " :: " + ln, tot))
+
+    oprows = [r for r in rows if "XLA Ops" in r[1]]
+    if not oprows:
+        oprows = rows
+    agg = defaultdict(lambda: [0.0, 0])
+    for pn, ln, name, dur, stats in oprows:
+        agg[name][0] += dur
+        agg[name][1] += 1
+    total = sum(v[0] for v in agg.values())
+    print("\n== top ops by self time (total device %.4f s, %.2f ms/step) =="
+          % (total, total / steps * 1e3))
+    out = []
+    for name, (tot, cnt) in sorted(agg.items(), key=lambda kv: -kv[1][0])[:topk]:
+        pct = 100.0 * tot / max(total, 1e-12)
+        print("  %6.2f%%  %9.3f ms  %6d  %s"
+              % (pct, tot * 1e3, cnt, name[:110]))
+        out.append({"name": name, "ms": tot * 1e3, "pct": pct, "count": cnt})
+    with open(os.path.join(trace_dir, "summary.json"), "w") as f:
+        json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="transformer")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--analyze-only", default=None)
+    args = ap.parse_args()
+    if args.analyze_only:
+        analyze(args.analyze_only, args.steps)
+    else:
+        td = capture(args.model, args.steps, args.batch)
+        analyze(td, args.steps)
