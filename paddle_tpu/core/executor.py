@@ -219,6 +219,24 @@ def build_step_fn(program, fetch_names, persist_names, pp_cfg=None,
     return step
 
 
+def _xla_compiler_options():
+    """PADDLE_TPU_XLA_OPTIONS="k=v,k=v" -> jit(compiler_options=...): the
+    gflags-style escape hatch for per-compile XLA/libtpu tuning knobs
+    (e.g. xla_tpu_scoped_vmem_limit_kib), mirroring the reference's
+    FLAGS_* passthrough to its executors."""
+    import os
+
+    raw = os.environ.get("PADDLE_TPU_XLA_OPTIONS", "").strip()
+    if not raw:
+        return {}
+    opts = {}
+    for item in raw.split(","):
+        if "=" in item:
+            k, v = item.split("=", 1)
+            opts[k.strip()] = v.strip()
+    return {"compiler_options": opts} if opts else {}
+
+
 class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else XLAPlace(0)
@@ -538,11 +556,12 @@ class Executor:
         step = build_step_fn(program, fetch_names, persist_names,
                              pp_cfg=pp_cfg, fuse_opt=mesh is None)
         donate = (0,)
+        extra = _xla_compiler_options()
         if mesh is None:
-            return jax.jit(step, donate_argnums=donate)
+            return jax.jit(step, donate_argnums=donate, **extra)
         in_shardings, out_shardings = self._mesh_shardings(
             program, feed_names, fetch_names, state_in_names, persist_names,
             mesh, dp_axis, sp_axis, seq_feeds, zero_state)
         return jax.jit(step, donate_argnums=donate,
                        in_shardings=in_shardings,
-                       out_shardings=out_shardings)
+                       out_shardings=out_shardings, **extra)

@@ -356,6 +356,27 @@ def _smooth_softmax_ce(env, op):
     put(env, op.output("Loss"), loss)
 
 
+@register("fused_linear_smooth_ce")
+def _fused_linear_smooth_ce(env, op):
+    """Vocab projection + label-smoothed softmax CE in one kernel: the
+    [.., V] logits never reach HBM (Pallas online-softmax forward, chunked
+    recompute backward — ``ops/fused_ce.py``). Replaces the reference's
+    projection + ``softmax_with_cross_entropy_op.cc`` pairing for the big-
+    vocab loss heads."""
+    from ...ops.fused_ce import linear_smooth_ce
+    from ..op_registry import mxu_cast
+
+    x = get(env, op.input("X"))
+    w = get(env, op.input("W"))
+    b = get(env, op.input("Bias"))
+    ids = get(env, op.input("Label")).astype(jnp.int32)
+    if ids.ndim == x.ndim:
+        ids = ids.squeeze(-1)
+    x, w, b = mxu_cast(x, w, b)
+    put(env, op.output("Loss"), linear_smooth_ce(
+        x, w, b, ids, op.attr("epsilon", 0.0)))
+
+
 @register("sigmoid_cross_entropy_with_logits")
 def _sigmoid_ce(env, op):
     x = get(env, op.input("X"))

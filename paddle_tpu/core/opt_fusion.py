@@ -31,6 +31,10 @@ def plan_opt_fusion(ops):
             continue
         if op.input("GradRows") is not None:
             continue
+        if op.attrs.get("_switch_cond") is not None:
+            # Switch-guarded update: run_op's conditional output revert
+            # must apply, which the batched path would bypass
+            continue
         lr = op.input("LearningRate")
         key = (op.type, lr.name if lr is not None else None,
                op.attr("beta1", None), op.attr("beta2", None),

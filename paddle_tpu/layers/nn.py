@@ -19,7 +19,7 @@ __all__ = [
     "depthwise_conv2d", "pool2d", "adaptive_pool2d", "batch_norm",
     "layer_norm", "group_norm", "dropout", "softmax", "log_softmax",
     "cross_entropy", "softmax_with_cross_entropy",
-    "smooth_softmax_with_cross_entropy",
+    "smooth_softmax_with_cross_entropy", "fused_linear_smooth_ce",
     "sigmoid_cross_entropy_with_logits", "square_error_cost", "smooth_l1",
     "huber_loss", "label_smooth", "kldiv_loss", "bpr_loss", "hinge_loss",
     "log_loss", "margin_rank_loss", "mse_loss",
@@ -747,6 +747,30 @@ def smooth_softmax_with_cross_entropy(logits, label, epsilon=0.0):
     helper.append_op("smooth_softmax_ce",
                      {"Logits": logits, "Label": label},
                      {"Loss": loss}, {"epsilon": float(epsilon)})
+    return loss
+
+
+def fused_linear_smooth_ce(input, label, size, epsilon=0.0,
+                           param_attr=None, bias_attr=None, name=None):
+    """Vocab projection + label-smoothed softmax CE, fused (the TPU
+    replacement for ``fc(size=V)`` + ``smooth_softmax_with_cross_entropy``:
+    on TPU the [.., V] logits stay in VMEM — see ``ops/fused_ce.py``).
+    ``input``: [..., D]; ``label``: int ids shaped like ``input[:-1]``.
+    Returns per-position f32 loss of shape ``input.shape[:-1]``."""
+    helper = LayerHelper("fused_linear_smooth_ce", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d_in = int(input.shape[-1])
+    w = helper.create_parameter(helper.param_attr, shape=[d_in, size],
+                                dtype=_dtype(input))
+    inputs = {"X": input, "W": w, "Label": label}
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, shape=[size],
+                                    dtype=_dtype(input), is_bias=True)
+        inputs["Bias"] = b
+    loss = helper.create_variable_for_type_inference(
+        dtype="float32", shape=tuple(input.shape[:-1]))
+    helper.append_op("fused_linear_smooth_ce", inputs, {"Loss": loss},
+                     {"epsilon": float(epsilon)})
     return loss
 
 

@@ -76,12 +76,10 @@ def bert_base(vocab_size=30522, seq_len=128, d_model=768, d_ff=3072,
     h = layers.fc(x, size=d_model, num_flatten_dims=2, act="gelu",
                   name="mlm_transform")
     h = layers.layer_norm(h, begin_norm_axis=2)
-    mlm_logits = layers.fc(h, size=vocab_size, num_flatten_dims=2,
-                           param_attr=ParamAttr(name="mlm_out.w",
-                                                sharding=(None, "mp")),
-                           name="mlm_out")
-    mlm_ce = layers.smooth_softmax_with_cross_entropy(
-        mlm_logits, mlm_labels)  # fused single-pass CE over the vocab
+    mlm_ce = layers.fused_linear_smooth_ce(
+        h, mlm_labels, size=vocab_size,
+        param_attr=ParamAttr(name="mlm_out.w", sharding=(None, "mp")),
+        name="mlm_out")  # fused projection + CE, no [B, S, V] in HBM
     mlm_loss = layers.elementwise_div(
         layers.reduce_sum(layers.elementwise_mul(mlm_ce, mlm_weights)),
         layers.elementwise_add(

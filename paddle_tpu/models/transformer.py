@@ -110,16 +110,13 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
         block_outs.append(dec.name)
     dec = layers.layer_norm(dec, begin_norm_axis=2)
 
-    logits = layers.fc(dec, size=trg_vocab, num_flatten_dims=2,
-                       bias_attr=False,
-                       param_attr=ParamAttr(name="out_proj.w",
-                                            sharding=(None, "mp")),
-                       name="out_proj")
-
-    # fused closed-form label smoothing: one logits pass, no [B, S, V]
-    # log-prob or soft-label materialization
-    ce = layers.smooth_softmax_with_cross_entropy(
-        logits, lbl, epsilon=label_smooth_eps)  # [B, S]
+    # fused projection + closed-form label smoothing: the [B, S, V] logits
+    # never hit HBM on TPU (ops/fused_ce.py Pallas kernel)
+    ce = layers.fused_linear_smooth_ce(
+        dec, lbl, size=trg_vocab, epsilon=label_smooth_eps,
+        bias_attr=False,
+        param_attr=ParamAttr(name="out_proj.w", sharding=(None, "mp")),
+        name="out_proj")  # [B, S]
     mask = layers.sequence_mask(trg_len, maxlen=seq_len, dtype="float32")
     tok_loss = layers.elementwise_mul(ce, mask)
     loss = layers.elementwise_div(layers.reduce_sum(tok_loss),

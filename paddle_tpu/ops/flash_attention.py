@@ -160,7 +160,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     from jax.experimental import pallas as pl
 
     q = q_ref[...]
-    do = do_ref[...].astype(jnp.float32)
+    do = do_ref[...]
     block_q, d = q.shape
     kv_pad = k_ref.shape[0]
     bh_idx = pl.program_id(0)
@@ -192,7 +192,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
         p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
                       0.0) * lse_okf[:, None]
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bq, bk] = dO V^T
         if dropout_rate > 0.0:
             keep = _dropout_keep((block_q, block_k), dropout_rate,
@@ -234,7 +234,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     def body(qb, carry):
         dk, dv, db = carry
         q = q_ref[pl.dslice(qb * block_q, block_q), :]
-        do = do_ref[pl.dslice(qb * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.dslice(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
         delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
         s = jax.lax.dot_general(
@@ -253,7 +253,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
         p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
                       0.0) * lse_okf[:, None]
         dp = jax.lax.dot_general(
-            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         p_drop = p
         if dropout_rate > 0.0:
@@ -263,13 +263,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
             p_drop = jnp.where(keep, p * inv, 0.0)
             dp = jnp.where(keep, dp * inv, 0.0)
         ds = p * (dp - delta[:, None])
-        # (0),(0)-contracting dots transpose their operands; Mosaic only
-        # supports that relayout for 32-bit types, so run them in f32
+        # bf16 operands on the transposed contractions: the MXU runs f32
+        # dots at a fraction of its bf16 rate
         dv = dv + jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
+            p_drop.astype(v.dtype), do.astype(v.dtype),
+            (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
         dk = dk + jax.lax.dot_general(
-            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         db = db + jnp.sum(ds, axis=0)  # per-key bias cotangent
         return dk, dv, db
@@ -518,120 +519,141 @@ def _flash_bwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate,
 def _dense_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
                       lse_ref, *, num_heads, causal, scale, q_len, kv_len,
                       dropout_rate):
-    t_pad, hd = q_ref.shape[1], q_ref.shape[2]
+    g_blk, t_pad, hd = q_ref.shape
     tk_pad = k_ref.shape[1]
     d = hd // num_heads
     from jax.experimental import pallas as pl
 
     b_idx = pl.program_id(0)
+    # one additive mask tile per grid step, hoisted out of the (g, h)
+    # loops: exp(-1e30 - m) underflows to exactly 0, so no per-head
+    # compare+select passes. do/q are zero-padded, so padded q rows produce
+    # ds == 0 in the backward and only garbage in discarded output rows.
     k_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 1)
     q_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 0)
     mask = k_pos < kv_len
     if causal:
         # end-anchored diagonal (matches mha_reference for t_q != t_k)
         mask = mask & (k_pos <= q_pos + (kv_len - q_len))
-    bias = None
-    if bias_ref is not None:
-        bias = bias_ref[0, 0, :].astype(jnp.float32)[None, :]
+    mask = jnp.where(mask, 0.0, -1e30)
 
-    for h in range(num_heads):
-        sl = pl.dslice(h * d, d)
-        qh = q_ref[0, :, sl]
-        kh = k_ref[0, :, sl]
-        vh = v_ref[0, :, sl]
-        s = jax.lax.dot_general(
-            qh, kh, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [t, tk]
-        if bias is not None:
-            s = s + bias
-        s = jnp.where(mask, s, -jnp.inf)
-        m = jnp.max(s, axis=1)
-        m_safe = jnp.where(jnp.isfinite(m), m, 0.0)
-        p = jnp.where(mask, jnp.exp(s - m_safe[:, None]), 0.0)
-        l = jnp.maximum(jnp.sum(p, axis=1), 1e-30)
-        p_use = p
-        if dropout_rate > 0.0:
-            keep = _dropout_keep((t_pad, tk_pad), dropout_rate,
-                                 seed_ref[0, 0],
-                                 (b_idx * num_heads + h, 0, 0))
-            p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-        o_h = jax.lax.dot_general(
-            p_use.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) / l[:, None]
-        o_ref[0, :, sl] = o_h.astype(o_ref.dtype)
-        lse = jnp.where(jnp.isfinite(m), m + jnp.log(l), -jnp.inf)
-        lse_ref[0, h, :] = lse.astype(jnp.float32)
+    # several batch elements per grid step: at T<=512 one element is only
+    # a few us of compute, so the per-step fixed cost (DMA issue, loop
+    # bookkeeping) dominates a G=1 grid (measured flat 5.5us/step
+    # regardless of in-kernel math, NOTES_r3.md)
+    for g in range(g_blk):
+        mb = mask
+        if bias_ref is not None:
+            mb = mb + bias_ref[g, 0, :].astype(jnp.float32)[None, :]
+        for h in range(num_heads):
+            sl = pl.dslice(h * d, d)
+            qh = q_ref[g, :, sl]
+            kh = k_ref[g, :, sl]
+            vh = v_ref[g, :, sl]
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale + mb  # [t, tk]
+            m = jnp.max(s, axis=1)
+            m_safe = jnp.maximum(m, -1e30)  # fully-masked rows: exp -> 0
+            p = jnp.exp(s - m_safe[:, None])
+            l = jnp.maximum(jnp.sum(p, axis=1), 1e-30)
+            p_use = p
+            if dropout_rate > 0.0:
+                keep = _dropout_keep(
+                    (t_pad, tk_pad), dropout_rate, seed_ref[0, 0],
+                    ((b_idx * g_blk + g) * num_heads + h, 0, 0))
+                p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            o_h = jax.lax.dot_general(
+                p_use.astype(vh.dtype), vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) / l[:, None]
+            o_ref[g, :, sl] = o_h.astype(o_ref.dtype)
+            lse_ref[g, h, :] = (m_safe + jnp.log(l)).astype(jnp.float32)
 
 
 def _dense_bwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
                       out_ref, lse_ref, dq_ref, dk_ref, dv_ref, db_ref, *,
                       num_heads, causal, scale, q_len, kv_len, dropout_rate):
-    t_pad, hd = q_ref.shape[1], q_ref.shape[2]
+    g_blk, t_pad, hd = q_ref.shape
     tk_pad = k_ref.shape[1]
     d = hd // num_heads
     from jax.experimental import pallas as pl
 
     b_idx = pl.program_id(0)
+    # additive mask+bias tile, hoisted (see _dense_fwd_kernel); lse is
+    # always finite here by the fwd's m_safe clamp
     k_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 1)
     q_pos = jax.lax.broadcasted_iota(jnp.int32, (t_pad, tk_pad), 0)
-    mask = (k_pos < kv_len) & (q_pos < q_len)
+    mask = k_pos < kv_len
     if causal:
         mask = mask & (k_pos <= q_pos + (kv_len - q_len))
-    bias = None
-    if bias_ref is not None:
-        bias = bias_ref[0, 0, :].astype(jnp.float32)[None, :]
-    db_acc = jnp.zeros((tk_pad,), jnp.float32) if db_ref is not None else None
+    mask = jnp.where(mask, 0.0, -1e30)
 
-    for h in range(num_heads):
-        sl = pl.dslice(h * d, d)
-        qh = q_ref[0, :, sl]
-        kh = k_ref[0, :, sl]
-        vh = v_ref[0, :, sl]
-        do = do_ref[0, :, sl].astype(jnp.float32)
-        o = out_ref[0, :, sl].astype(jnp.float32)
-        lse = lse_ref[0, h, :]
-        delta = jnp.sum(do * o, axis=1)  # [t]
-        lse_okf = jnp.isfinite(lse).astype(jnp.float32)
-        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
-        s = jax.lax.dot_general(
-            qh, kh, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if bias is not None:
-            s = s + bias
-        p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
-                      0.0) * lse_okf[:, None]
-        dp = jax.lax.dot_general(
-            do, vh.astype(jnp.float32), (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [t, tk]
-        p_drop = p
-        if dropout_rate > 0.0:
-            keep = _dropout_keep((t_pad, tk_pad), dropout_rate,
-                                 seed_ref[0, 0],
-                                 (b_idx * num_heads + h, 0, 0))
-            inv = 1.0 / (1.0 - dropout_rate)
-            p_drop = jnp.where(keep, p * inv, 0.0)
-            dp = jnp.where(keep, dp * inv, 0.0)
-        ds = p * (dp - delta[:, None])  # [t, tk]
-        dq_ref[0, :, sl] = (jax.lax.dot_general(
-            ds.astype(kh.dtype), kh, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale).astype(dq_ref.dtype)
-        # (0),(0)-contracting dots relayout their operands; Mosaic only
-        # supports that for 32-bit types, so run them in f32
-        dk_ref[0, :, sl] = (jax.lax.dot_general(
-            ds, qh.astype(jnp.float32), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale).astype(dk_ref.dtype)
-        dv_ref[0, :, sl] = jax.lax.dot_general(
-            p_drop, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-        if db_acc is not None:
-            db_acc = db_acc + jnp.sum(ds, axis=0)
-    if db_ref is not None:
-        db_ref[0, 0, :] = db_acc
+    for g in range(g_blk):
+        mb = mask
+        if bias_ref is not None:
+            mb = mb + bias_ref[g, 0, :].astype(jnp.float32)[None, :]
+        db_acc = (jnp.zeros((tk_pad,), jnp.float32)
+                  if db_ref is not None else None)
+        for h in range(num_heads):
+            sl = pl.dslice(h * d, d)
+            qh = q_ref[g, :, sl]
+            kh = k_ref[g, :, sl]
+            vh = v_ref[g, :, sl]
+            do = do_ref[g, :, sl]
+            o = out_ref[g, :, sl]
+            lse = lse_ref[g, h, :]
+            delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                            axis=1)  # [t]
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale + mb
+            p = jnp.exp(s - lse[:, None])
+            dp = jax.lax.dot_general(
+                do, vh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [t, tk]
+            p_drop = p
+            if dropout_rate > 0.0:
+                keep = _dropout_keep(
+                    (t_pad, tk_pad), dropout_rate, seed_ref[0, 0],
+                    ((b_idx * g_blk + g) * num_heads + h, 0, 0))
+                inv = 1.0 / (1.0 - dropout_rate)
+                p_drop = jnp.where(keep, p * inv, 0.0)
+                dp = jnp.where(keep, dp * inv, 0.0)
+            ds_f32 = p * (dp - delta[:, None])  # [t, tk]
+            ds = ds_f32.astype(qh.dtype)
+            dq_ref[g, :, sl] = (jax.lax.dot_general(
+                ds, kh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+                * scale).astype(dq_ref.dtype)
+            # bf16 operands on the transposed contractions too: the MXU
+            # runs f32 dots at a fraction of its bf16 rate, and the
+            # f32->bf16 cast is the same rounding the fwd products see
+            dk_ref[g, :, sl] = (jax.lax.dot_general(
+                ds, qh, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+                * scale).astype(dk_ref.dtype)
+            dv_ref[g, :, sl] = jax.lax.dot_general(
+                p_drop.astype(vh.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+            if db_acc is not None:
+                db_acc = db_acc + jnp.sum(ds_f32, axis=0)
+        if db_ref is not None:
+            db_ref[g, 0, :] = db_acc
 
 
 def _pad_last(x, m):
     r = (-x.shape[1]) % m
     return jnp.pad(x, ((0, 0), (0, r), (0, 0))) if r else x
+
+
+def _pick_g(b, per_elem_bytes, budget=4 * 1024 * 1024):
+    """Batch elements per grid step: enough to amortize the ~5.5us fixed
+    per-step cost, bounded by the VMEM block budget (blocks are double-
+    buffered across grid steps, so they cost twice their size)."""
+    for g in (8, 4, 2, 1):
+        if b % g == 0 and g * per_elem_bytes <= budget:
+            return g
+    return 1
 
 
 def _dense_fwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
@@ -646,19 +668,20 @@ def _dense_fwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
     qp = _pad_last(q, m)
     kp, vp = _pad_last(k, m), _pad_last(v, m)
     t_pad, tk_pad = qp.shape[1], kp.shape[1]
+    g = _pick_g(b, 2 * (t_pad + tk_pad) * hd * q.dtype.itemsize)
 
     kernel = functools.partial(
         _dense_fwd_kernel, num_heads=num_heads, causal=causal, scale=scale,
         q_len=t, kv_len=t_k, dropout_rate=dropout_rate)
     in_specs = [
-        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, tk_pad, hd), lambda bi: (bi, 0, 0)),
     ]
     args = [qp, kp, vp]
     if bias is not None:
         bp = _pad_vec(bias, m)
-        in_specs.append(pl.BlockSpec((1, 8, tk_pad), lambda bi: (bi, 0, 0)))
+        in_specs.append(pl.BlockSpec((g, 8, tk_pad), lambda bi: (bi, 0, 0)))
         args.append(jnp.broadcast_to(bp[:, None, :], (b, 8, tk_pad)))
 
     def entry(*refs):
@@ -674,11 +697,11 @@ def _dense_fwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
     nh_pad = max(num_heads, 8)  # sublane-tiled stats block
     out, lse = pl.pallas_call(
         entry,
-        grid=(b,),
+        grid=(b // g,),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
-            pl.BlockSpec((1, nh_pad, t_pad), lambda bi: (bi, 0, 0)),
+            pl.BlockSpec((g, t_pad, hd), lambda bi: (bi, 0, 0)),
+            pl.BlockSpec((g, nh_pad, t_pad), lambda bi: (bi, 0, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b, t_pad, hd), q.dtype),
@@ -700,26 +723,27 @@ def _dense_bwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
     dop, outp = _pad_last(do, m), _pad_last(out, m)
     t_pad, tk_pad = qp.shape[1], kp.shape[1]
     nh_pad = lse.shape[1]
+    g = _pick_g(b, 4 * (t_pad + tk_pad) * hd * q.dtype.itemsize)
 
     kernel = functools.partial(
         _dense_bwd_kernel, num_heads=num_heads, causal=causal, scale=scale,
         q_len=t, kv_len=t_k, dropout_rate=dropout_rate)
     in_specs = [
-        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, tk_pad, hd), lambda bi: (bi, 0, 0)),
     ]
     args = [qp, kp, vp]
     if bias is not None:
         bp = _pad_vec(bias, m)
-        in_specs.append(pl.BlockSpec((1, 8, tk_pad), lambda bi: (bi, 0, 0)))
+        in_specs.append(pl.BlockSpec((g, 8, tk_pad), lambda bi: (bi, 0, 0)))
         args.append(jnp.broadcast_to(bp[:, None, :], (b, 8, tk_pad)))
     in_specs.append(pl.BlockSpec((1, 1), lambda bi: (0, 0)))
     args.append(jnp.asarray([[seed]], jnp.uint32))
     in_specs += [
-        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, nh_pad, t_pad), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, nh_pad, t_pad), lambda bi: (bi, 0, 0)),
     ]
     args += [dop, outp, lse]
 
@@ -735,9 +759,9 @@ def _dense_bwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
                dq_ref, dk_ref, dv_ref, db_ref)
 
     out_specs = [
-        pl.BlockSpec((1, t_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
-        pl.BlockSpec((1, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, t_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, tk_pad, hd), lambda bi: (bi, 0, 0)),
+        pl.BlockSpec((g, tk_pad, hd), lambda bi: (bi, 0, 0)),
     ]
     out_shape = [
         jax.ShapeDtypeStruct((b, t_pad, hd), q.dtype),
@@ -745,11 +769,11 @@ def _dense_bwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
         jax.ShapeDtypeStruct((b, tk_pad, hd), v.dtype),
     ]
     if bias is not None:
-        out_specs.append(pl.BlockSpec((1, 8, tk_pad), lambda bi: (bi, 0, 0)))
+        out_specs.append(pl.BlockSpec((g, 8, tk_pad), lambda bi: (bi, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct((b, 8, tk_pad), jnp.float32))
     res = pl.pallas_call(
         entry,
-        grid=(b,),
+        grid=(b // g,),
         in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
@@ -879,8 +903,12 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
         seed = jnp.uint32(0)
 
     # short sequences: whole-sequence VMEM-resident kernel on the packed
-    # layout (no head-split transposes, heads looped in-kernel)
+    # layout (no head-split transposes, heads looped in-kernel). Causal
+    # with t > t_k would create fully-masked rows, whose additive-mask
+    # softmax (uniform over tk_pad incl. padding) diverges from the
+    # reference's uniform-over-real-keys — keep those on the fallback.
     if (pallas_ok and t <= _DENSE_MAX_Q and t_k <= _DENSE_MAX_KV
+            and (not causal or t <= t_k)
             and _dense_fits(t, t_k, hd, q.dtype.itemsize)):
         return _dense_attention(q, k, v, key_bias, seed, num_heads, causal,
                                 scale, float(dropout_rate))

@@ -1,0 +1,28 @@
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import time, numpy as np, jax
+import paddle_tpu as fluid
+from paddle_tpu import models
+
+kw = {}
+for a in sys.argv[1:]:
+    k, v = a.split("=")
+    kw[k] = float(v) if "." in v else int(v)
+main, startup = fluid.Program(), fluid.Program()
+with fluid.program_guard(main, startup):
+    spec = models.transformer.transformer_base(seq_len=256, **kw)
+    opt = fluid.amp.decorate(fluid.optimizer.Adam(learning_rate=1e-4))
+    opt.minimize(spec.loss)
+exe = fluid.Executor(fluid.XLAPlace(0))
+scope = fluid.Scope()
+with fluid.scope_guard(scope):
+    exe.run(startup)
+    feed = {k: jax.device_put(v) for k, v in spec.sample_batch(128, np.random.RandomState(0)).items()}
+    for _ in range(2):
+        l, = exe.run(main, feed=feed, fetch_list=[spec.loss])
+    np.asarray(l)
+    t0 = time.perf_counter()
+    for _ in range(30):
+        l, = exe.run(main, feed=feed, fetch_list=[spec.loss], return_numpy=False)
+    np.asarray(l); dt = time.perf_counter()-t0
+print("%.1f tok/s; step %.1f ms" % (128*256*30/dt, dt/30*1e3))
