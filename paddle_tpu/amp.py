@@ -61,11 +61,12 @@ class _DecoratedOptimizer:
         return getattr(self._opt, name)
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, accumulate_steps=None):
         enable_bf16(loss.block.program)
         return self._opt.minimize(loss, startup_program=startup_program,
                                   parameter_list=parameter_list,
-                                  no_grad_set=no_grad_set)
+                                  no_grad_set=no_grad_set,
+                                  accumulate_steps=accumulate_steps)
 
 
 def decorate(optimizer, init_loss_scaling=1.0,

@@ -163,7 +163,7 @@ def _make_rng_key(seed):
 
 
 def build_step_fn(program, fetch_names, persist_names, pp_cfg=None,
-                  fuse_opt=True):
+                  fuse_opt=True, grad_scale=None):
     """Trace a program's global block into one pure function
     ``(state, feed, rng) -> (fetches, new_state, rng')`` — the unit the
     Executor jits, ``__graft_entry__`` exposes, and bench.py times.
@@ -195,6 +195,10 @@ def build_step_fn(program, fetch_names, persist_names, pp_cfg=None,
         env[RNG0_KEY] = rng
         if pp_cfg is not None:
             env[PP_KEY] = pp_cfg
+        if grad_scale is not None:
+            from .op_registry import GRAD_SCALE_KEY
+
+            env[GRAD_SCALE_KEY] = grad_scale
         # Step-start snapshot: the autodiff replay re-runs the forward from
         # here (not from the post-forward env), so in-place ops — e.g. the LR
         # schedule's step-counter increment — apply exactly once per step.
@@ -269,6 +273,7 @@ class Executor:
         seq_feeds = None
         pp = None
         zero_state = False
+        grad_scale = None
         if isinstance(program, CompiledProgram):
             from .compiler import BuildStrategy
 
@@ -279,6 +284,21 @@ class Executor:
             bs = program._build_strategy
             zero_state = (bs is not None and bs.reduce_strategy ==
                           BuildStrategy.ReduceStrategy.Reduce)
+            if bs is not None:
+                gss = BuildStrategy.GradientScaleStrategy
+                if bs.gradient_scale_strategy == gss.One:
+                    # ref details/build_strategy.h kGradientScaleOne: sum
+                    # of per-device local-mean grads instead of the global
+                    # mean — with GSPMD the whole-batch mean comes out of
+                    # autodiff, so One multiplies the loss cotangent by
+                    # the dp world size
+                    n_dp = (dict(zip(mesh.axis_names, mesh.devices.shape))
+                            .get(dp_axis, 1) if mesh is not None else 1)
+                    grad_scale = float(n_dp)
+                elif bs.gradient_scale_strategy == gss.Customized:
+                    # ref kGradientScaleCustomized: the user feeds the loss
+                    # cotangent as "<loss>@GRAD" (checked at autodiff time)
+                    grad_scale = "customized"
             if program._pp_axis is not None:
                 pp = (program._pp_axis, program._pp_boundaries,
                       program._pp_nmicro)
@@ -351,13 +371,13 @@ class Executor:
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
                state_in_names, id(scope), mesh, dp_axis, sp_axis, seq_feeds,
-               pp, zero_state)
+               pp, zero_state, grad_scale)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._compile(program, tuple(sorted(feed_arrays)),
                                   fetch_names, state_in_names, persist_names,
                                   mesh, dp_axis, sp_axis, seq_feeds, pp,
-                                  zero_state)
+                                  zero_state, grad_scale)
             if use_program_cache:
                 self._cache[key] = entry
         jfn = entry
@@ -523,6 +543,11 @@ class Executor:
                 # no data-parallel axis (e.g. a pipeline-only mesh):
                 # feeds stay replicated, the engine slices microbatches
                 return repl
+            shp = gb.var(name).shape if gb.has_var(name) else None
+            if shp is None or len(shp) == 0:
+                # out-of-program feeds (e.g. a Customized loss cotangent)
+                # and scalars have no batch axis to shard
+                return repl
             if name in sp_names:
                 return NamedSharding(mesh, P(dp_axis, sp_axis))
             return NamedSharding(mesh, P(dp_axis))
@@ -546,7 +571,7 @@ class Executor:
 
     def _compile(self, program, feed_names, fetch_names, state_in_names,
                  persist_names, mesh, dp_axis, sp_axis=None, seq_feeds=None,
-                 pp=None, zero_state=False):
+                 pp=None, zero_state=False, grad_scale=None):
         pp_cfg = None
         if pp is not None:
             pp_axis, pp_boundaries, pp_nmicro = pp
@@ -554,7 +579,8 @@ class Executor:
                       "boundaries": list(pp_boundaries),
                       "n_micro": pp_nmicro, "feed_names": list(feed_names)}
         step = build_step_fn(program, fetch_names, persist_names,
-                             pp_cfg=pp_cfg, fuse_opt=mesh is None)
+                             pp_cfg=pp_cfg, fuse_opt=mesh is None,
+                             grad_scale=grad_scale)
         donate = (0,)
         extra = _xla_compiler_options()
         if mesh is None:

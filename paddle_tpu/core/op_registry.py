@@ -22,6 +22,7 @@ RNG_KEY = "@RNG@"
 RNG0_KEY = "@RNG0@"  # snapshot at step start, used for autodiff replay
 ENV0_KEY = "@ENV0@"  # dict snapshot of env at step start (autodiff replay base)
 PP_KEY = "@PP@"      # pipeline-parallel config (mesh, axis, boundaries, ...)
+GRAD_SCALE_KEY = "@GRAD_SCALE@"  # BuildStrategy.GradientScaleStrategy
 
 
 def register(*names):
@@ -47,6 +48,17 @@ def env_flag(name):
 
     return os.environ.get(name, "").strip().lower() in (
         "1", "true", "yes", "on")
+
+
+def single_tpu():
+    """True when running on exactly one TPU device — the only config where
+    a Pallas custom call doesn't fight GSPMD (under a mesh it would force
+    gathers of sharded operands). Shared gate for the fused kernels."""
+    try:
+        dev = jax.devices()[0]
+    except Exception:
+        return False
+    return dev.platform == "tpu" and jax.device_count() == 1
 
 
 def run_op(env, op):

@@ -17,7 +17,27 @@ import jax
 import jax.numpy as jnp
 
 from ..op_registry import (register, get, put, run_op, RNG_KEY, RNG0_KEY,
-                           ENV0_KEY, PP_KEY)
+                           ENV0_KEY, PP_KEY, GRAD_SCALE_KEY)
+
+
+def _loss_seed(env, loss_name, loss_val):
+    """BuildStrategy.GradientScaleStrategy (ref ``build_strategy.h:35``):
+    scale the loss cotangent. ``One`` multiplies by the dp world size
+    (sum-of-device-grads semantics); ``Customized`` reads the user-fed
+    ``<loss>@GRAD`` cotangent, matching the reference's custom loss@GRAD
+    tensor."""
+    gs = env.get(GRAD_SCALE_KEY)
+    if gs is None:
+        return jnp.sum(loss_val)
+    if gs == "customized":
+        seed = env.get(loss_name + "@GRAD")
+        if seed is None:
+            raise ValueError(
+                "GradientScaleStrategy.Customized requires feeding the "
+                "loss cotangent as '%s@GRAD'" % loss_name)
+        return jnp.sum(loss_val * seed.reshape(loss_val.shape)
+                       .astype(loss_val.dtype))
+    return jnp.sum(loss_val) * float(gs)
 
 
 def _replay_base(env, fwd_ops, export):
@@ -119,7 +139,7 @@ def _autodiff(env, op):
                 out_name = site[2]
                 local[out_name] = local[out_name] + args["d"][site[0]]
         aux = {n: local[n] for n in fwd_out_names if n in local}
-        return jnp.sum(local[loss_var.name]), aux
+        return _loss_seed(env, loss_var.name, local[loss_var.name]), aux
 
     if op.attr("remat"):
         # coarse rematerialization (≡ reference memory_optimize pass):

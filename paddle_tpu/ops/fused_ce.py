@@ -39,17 +39,11 @@ _FUSED_MIN_LOGITS = 1.5e9
 def _use_fused(x, w):
     if _INTERPRET:
         return True
-    from ..core.op_registry import env_flag
+    from ..core.op_registry import env_flag, single_tpu
 
     if env_flag("PADDLE_TPU_NO_FUSED_CE"):  # A/B escape hatch
         return False
-    try:
-        dev = jax.devices()[0]
-    except Exception:
-        return False
-    # single-device TPU only: under a GSPMD mesh the custom call would
-    # force an all-gather of the (possibly mp-sharded) weight
-    if dev.platform != "tpu" or jax.device_count() != 1:
+    if not single_tpu():
         return False
     n_logits = (x.size // x.shape[-1]) * w.shape[1]
     return (n_logits >= _FUSED_MIN_LOGITS

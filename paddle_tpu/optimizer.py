@@ -123,18 +123,153 @@ class Optimizer:
                  no_grad_set=None, callbacks=None):
         return append_backward(loss, parameter_list, no_grad_set, callbacks)
 
-    def apply_gradients(self, params_grads):
+    def _append_grad_accumulation(self, params_grads, k):
+        """Gradient accumulation (ref ``framework/ir/multi_batch_merge_pass
+        .cc``, driven by ``dist_mnist_batch_merge.py``): raw grads sum into
+        persistable buffers for ``k`` micro-steps; downstream clip/
+        regularization/update consume the RUNNING AVERAGE, and the update
+        ops fire only on every k-th step (Switch-conditioned, so their
+        outputs revert to the previous state in between). k micro-steps of
+        batch b are numerically one step of batch k*b (mean-loss grads).
+
+        Returns (averaged params_grads, apply-condition var)."""
+        from .layers import nn as lnn
+        from .layers import tensor as ltensor
+        from .layers import control_flow as lcf
+
+        prog = params_grads[0][0].block.program
+        block = prog.global_block()
+        self._rescale_lr_decay_counter(block, k)
+        with framework.name_scope("grad_acc"):
+            counter = lnn.autoincreased_step_counter(
+                counter_name=unique_name.generate("@GRAD_ACC_COUNTER@"))
+            kvar = ltensor.fill_constant([1], "int64", k)
+            phase = block.create_var(
+                name=unique_name.generate("grad_acc_phase"),
+                shape=(1,), dtype="int64")
+            block.append_op("elementwise_mod", {"X": counter, "Y": kvar},
+                            {"Out": phase}, {})
+            apply_cond = lcf.equal(phase,
+                                   ltensor.fill_constant([1], "int64", 0))
+            # keep factor: 0.0 on apply steps (reset), 1.0 otherwise
+            not_apply = block.create_var(
+                name=unique_name.generate("grad_acc_keep"),
+                shape=(1,), dtype="bool")
+            block.append_op("logical_not", {"X": apply_cond},
+                            {"Out": not_apply}, {})
+            keep_f = ltensor.cast(not_apply, "float32")
+
+            new_pg = []
+            for p, g in params_grads:
+                if getattr(g, "sparse_rows_var", None) is not None:
+                    raise NotImplementedError(
+                        "accumulate_steps with sparse (is_sparse=True) "
+                        "gradients is not supported; use dense embedding "
+                        "gradients when accumulating")
+                acc = block.create_var(
+                    name=unique_name.generate("%s@GRAD_ACC" % p.name),
+                    shape=g.shape, dtype=str(g.dtype), persistable=True)
+                sb = framework.default_startup_program().global_block()
+                sp = sb.create_var(name=acc.name, shape=g.shape,
+                                   dtype=str(g.dtype), persistable=True)
+                sb.append_op("fill_constant", outputs={"Out": sp},
+                             attrs={"shape": tuple(g.shape),
+                                    "dtype": str(g.dtype), "value": 0.0})
+                acc_sum = block.create_var(
+                    name=unique_name.generate("%s@GRAD_ACC_SUM" % p.name),
+                    shape=g.shape, dtype=str(g.dtype))
+                block.append_op("elementwise_add", {"X": acc, "Y": g},
+                                {"Out": acc_sum}, {})
+                avg = lnn.scale(acc_sum, scale=1.0 / k)
+                # write-back: keep the sum between apply steps, reset after
+                block.append_op("elementwise_mul",
+                                {"X": acc_sum, "Y": keep_f},
+                                {"Out": block.vars[acc.name]},
+                                {"axis": -1})
+                new_pg.append((p, avg))
+        return new_pg, apply_cond
+
+    def _rescale_lr_decay_counter(self, block, k):
+        """LR schedules tick their ``@LR_DECAY_COUNTER@`` once per executor
+        run; under accumulation the reference's merged program ticks once
+        per k micro-batches (``multi_batch_merge_pass.cc`` runs the
+        schedule once per merged run). Match it by rewiring every schedule
+        op to read ``ceil(counter / k)`` instead of the raw counter."""
+        from .core.framework import Operator
+
+        name = "@LR_DECAY_COUNTER@"
+        if not any(name == n for op in block.ops
+                   for n in op.output_arg_names):
+            return
+        inc_idx = max(i for i, op in enumerate(block.ops)
+                      if name in op.output_arg_names)
+        counter = block.vars[name]
+        kconst = block.create_var(
+            name=unique_name.generate("lr_counter_k"),
+            shape=(1,), dtype="int64")
+        eff = block.create_var(
+            name=unique_name.generate("lr_counter_eff"),
+            shape=(1,), dtype="int64")
+        # the schedules see a 0-based effective-step count: micro-steps
+        # t*k .. t*k+k-1 all map to effective step t, so the k-th
+        # micro-step's APPLY uses exactly the lr the merged big-batch
+        # step t would
+        new_ops = [
+            Operator(block, "fill_constant", None, {"Out": kconst},
+                     {"shape": (1,), "dtype": "int64", "value": float(k)}),
+            Operator(block, "elementwise_floordiv",
+                     {"X": counter, "Y": kconst}, {"Out": eff}, {}),
+        ]
+        inc_op = block.ops[inc_idx]
+        for j, op in enumerate(new_ops):
+            block.ops.insert(inc_idx + 1 + j, op)
+        # rewire downstream readers (the schedule's cast/pow/... chain)
+        for op in block.ops[inc_idx + 1 + len(new_ops):]:
+            for slot, vs in op.inputs.items():
+                op.inputs[slot] = [eff if v.name == name else v for v in vs]
+        # the backward replay runs the autodiff op's CAPTURED fwd_ops list
+        # (same Operator objects, separate list) — mirror the insertion
+        # there or the rewired readers see an undefined var in the replay
+        for op in block.ops:
+            if op.type != "autodiff":
+                continue
+            fwd = op.attrs.get("fwd_ops") or []
+            for i, f in enumerate(fwd):
+                if f is inc_op:
+                    op.attrs["fwd_ops"] = (fwd[:i + 1] + new_ops
+                                           + fwd[i + 1:])
+                    break
+        block.program._version += 1
+
+    def apply_gradients(self, params_grads, accumulate_steps=None):
+        apply_cond = None
+        if accumulate_steps is not None and accumulate_steps > 1:
+            params_grads, apply_cond = self._append_grad_accumulation(
+                params_grads, int(accumulate_steps))
         params_grads = append_gradient_clip_ops(params_grads)
         params_grads = append_regularization_ops(params_grads,
                                                  self.regularization)
         self._final_params_grads = params_grads
-        return self._create_optimization_pass(params_grads)
+        block = params_grads[0][0].block.program.global_block()
+        n0 = len(block.ops)
+        ops = self._create_optimization_pass(params_grads)
+        if apply_cond is not None:
+            # Guard EVERY persistable-state write appended by the pass
+            # (update ops AND _finish_update extras like Adamax's beta-pow
+            # scale, ModelAverage/EMA accumulators) — anything less lets
+            # auxiliary state advance per micro-step
+            for op in block.ops[n0:]:
+                for vs in op.outputs.values():
+                    if any(getattr(v, "persistable", False) for v in vs):
+                        op.attrs["_switch_cond"] = apply_cond.name
+                        break
+        return ops
 
     def minimize(self, loss, startup_program=None, parameter_list=None,
-                 no_grad_set=None):
+                 no_grad_set=None, accumulate_steps=None):
         params_grads = self.backward(loss, startup_program, parameter_list,
                                      no_grad_set)
-        optimize_ops = self.apply_gradients(params_grads)
+        optimize_ops = self.apply_gradients(params_grads, accumulate_steps)
         # return the post-clip/regularization pairs (what the update ops
         # actually consume) — more useful than the raw backward outputs
         return optimize_ops, self._final_params_grads

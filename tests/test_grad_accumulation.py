@@ -1,0 +1,114 @@
+"""Gradient accumulation (ref ``multi_batch_merge_pass.cc`` capability):
+k micro-steps at batch b must equal one step at batch k*b, and parameters
+must stay FROZEN between apply steps."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import layers
+
+
+def _build(opt_factory, accumulate_steps):
+    from paddle_tpu.core import unique_name
+
+    old_gen = unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 77
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[12], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        h = layers.fc(x, size=24, act="relu")
+        pred = layers.fc(h, size=1)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        opt_factory().minimize(loss, accumulate_steps=accumulate_steps)
+    unique_name.switch(old_gen)
+    return main, startup, loss
+
+
+def _lr_sched_opt():
+    # decays every effective step: exposes per-micro-step schedule ticking
+    lr = layers.exponential_decay(learning_rate=0.1, decay_steps=1,
+                                  decay_rate=0.5, staircase=True)
+    return fluid.optimizer.SGD(learning_rate=lr)
+
+
+def _params(scope, main):
+    return {p.name: scope.numpy(p.name).copy()
+            for p in main.global_block().all_parameters()}
+
+
+@pytest.mark.parametrize("opt_factory", [
+    lambda: fluid.optimizer.SGD(learning_rate=0.1),
+    lambda: fluid.optimizer.Adam(learning_rate=0.05),
+    lambda: fluid.optimizer.Adamax(learning_rate=0.05),
+    _lr_sched_opt,
+], ids=["sgd", "adam", "adamax", "lr_schedule"])
+def test_k_micro_steps_equal_one_big_step(opt_factory):
+    k = 4
+    rng = np.random.RandomState(0)
+    X = rng.randn(k * 8, 12).astype(np.float32)
+    Y = rng.randn(k * 8, 1).astype(np.float32)
+
+    # accumulated: k micro-batches of 8
+    main_a, startup_a, loss_a = _build(opt_factory, accumulate_steps=k)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(startup_a)
+        before = _params(scope_a, main_a)
+        for i in range(k - 1):
+            exe.run(main_a, feed={"x": X[i * 8:(i + 1) * 8],
+                                  "y": Y[i * 8:(i + 1) * 8]},
+                    fetch_list=[loss_a])
+            frozen = _params(scope_a, main_a)
+            for name in before:  # no update before the k-th micro-step
+                np.testing.assert_array_equal(before[name], frozen[name],
+                                              err_msg=name)
+        exe.run(main_a, feed={"x": X[(k - 1) * 8:], "y": Y[(k - 1) * 8:]},
+                fetch_list=[loss_a])
+        after_acc = _params(scope_a, main_a)
+
+    # one big batch of k*8
+    main_b, startup_b, loss_b = _build(opt_factory, accumulate_steps=None)
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup_b)
+        exe.run(main_b, feed={"x": X, "y": Y}, fetch_list=[loss_b])
+        after_big = _params(scope_b, main_b)
+
+    assert set(after_acc) == set(after_big)
+    for name in after_acc:
+        np.testing.assert_allclose(after_acc[name], after_big[name],
+                                   rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+def test_accumulation_trains():
+    """End-to-end: accumulated training still converges."""
+    main, startup, loss = _build(
+        lambda: fluid.optimizer.Adam(learning_rate=0.05), 2)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(1)
+    w = rng.randn(12, 1)
+    X = rng.randn(64, 12).astype(np.float32)
+    Y = (X @ w).astype(np.float32)
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        first = None
+        for _ in range(40):
+            l, = exe.run(main, feed={"x": X, "y": Y}, fetch_list=[loss])
+            if first is None:
+                first = float(l)
+    assert float(l) < 0.2 * first
+
+
+def test_sparse_grads_rejected():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        ids = layers.data("ids", shape=[4], dtype="int64")
+        emb = layers.embedding(ids, size=(50, 8), is_sparse=True)
+        loss = layers.mean(emb)
+        with pytest.raises(NotImplementedError):
+            fluid.optimizer.SGD(learning_rate=0.1).minimize(
+                loss, accumulate_steps=2)

@@ -1,0 +1,71 @@
+"""BuildStrategy.GradientScaleStrategy semantics (ref
+``details/build_strategy.h:35-140``): CoeffNumDevice (default) averages
+over the dp axis; One sums (grads x world size); Customized consumes a
+user-fed ``<loss>@GRAD`` cotangent."""
+
+import numpy as np
+
+import jax
+import paddle_tpu as fluid
+from paddle_tpu import layers
+from paddle_tpu.core.compiler import BuildStrategy
+
+
+def _build():
+    from paddle_tpu.core import unique_name
+
+    old = unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    with fluid.program_guard(main, startup):
+        x = layers.data("x", shape=[8], dtype="float32")
+        y = layers.data("y", shape=[1], dtype="float32")
+        pred = layers.fc(x, size=1, bias_attr=False)
+        loss = layers.mean(layers.square_error_cost(pred, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    unique_name.switch(old)
+    return main, startup, loss
+
+
+def _run(strategy, loss_grad=None):
+    main, startup, loss = _build()
+    bs = BuildStrategy()
+    bs.gradient_scale_strategy = strategy
+    compiled = fluid.CompiledProgram(main).with_data_parallel(
+        loss_name=loss.name, build_strategy=bs)
+    exe = fluid.Executor(fluid.CPUPlace())
+    scope = fluid.Scope()
+    rng = np.random.RandomState(0)
+    feed = {"x": rng.randn(16, 8).astype(np.float32),
+            "y": rng.randn(16, 1).astype(np.float32)}
+    if loss_grad is not None:
+        feed[loss.name + "@GRAD"] = loss_grad
+    wname = main.global_block().all_parameters()[0].name
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        w0 = scope.numpy(wname).copy()
+        exe.run(compiled, feed=feed, fetch_list=[loss])
+        w1 = scope.numpy(wname).copy()
+    return w1 - w0
+
+
+def test_one_scales_by_world_size():
+    n_dev = jax.device_count()
+    d_coeff = _run(BuildStrategy.GradientScaleStrategy.CoeffNumDevice)
+    d_one = _run(BuildStrategy.GradientScaleStrategy.One)
+    assert np.abs(d_coeff).max() > 0
+    np.testing.assert_allclose(d_one, d_coeff * n_dev, rtol=1e-4, atol=1e-6)
+
+
+def test_customized_consumes_fed_cotangent():
+    d_coeff = _run(BuildStrategy.GradientScaleStrategy.CoeffNumDevice)
+    d_cust = _run(BuildStrategy.GradientScaleStrategy.Customized,
+                  loss_grad=np.asarray(3.0, np.float32))
+    np.testing.assert_allclose(d_cust, d_coeff * 3.0, rtol=1e-4, atol=1e-6)
+
+
+def test_customized_without_feed_raises():
+    import pytest
+
+    with pytest.raises(Exception, match="Customized"):
+        _run(BuildStrategy.GradientScaleStrategy.Customized)
