@@ -63,17 +63,71 @@ def _build(model, on_tpu):
     raise SystemExit("unknown model %r" % model)
 
 
+def _bench_bert_dygraph(on_tpu):
+    """BASELINE config 4 as written: BERT through the DYGRAPH build,
+    functional export -> one jitted train step (models/bert_dygraph.py)."""
+    import jax
+    import numpy as np
+    from paddle_tpu.models import bert_dygraph
+
+    amp = os.environ.get("BENCH_AMP", "1") == "1"
+    if on_tpu:
+        cfg = dict(seq_len=128, amp=amp)
+    else:
+        cfg = dict(vocab_size=1000, seq_len=32, d_model=128, d_ff=256,
+                   n_layer=2, n_head=4, amp=amp)
+    model, feed_names, flops_per_example, toks = \
+        bert_dygraph.bert_base_dygraph(**cfg)
+    batch = int(os.environ.get("BENCH_BATCH", 128 if on_tpu else 4))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
+    feeds = bert_dygraph.sample_batch(batch, cfg["seq_len"],
+                                      cfg.get("vocab_size", 30522),
+                                      np.random.RandomState(0))
+    import paddle_tpu as fluid
+    with fluid.dygraph.guard():
+        model(*feeds)  # materialize lazily-built params
+    step, params, opt_state = bert_dygraph.make_train_step(model)
+    jstep = jax.jit(step, donate_argnums=(0, 1))
+    feeds = tuple(jax.device_put(f) for f in feeds)
+    key = jax.random.PRNGKey(0)
+    for _ in range(2):
+        key, sub = jax.random.split(key)
+        loss, params, opt_state = jstep(params, opt_state, sub, *feeds)
+    np.asarray(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        key, sub = jax.random.split(key)
+        loss, params, opt_state = jstep(params, opt_state, sub, *feeds)
+    np.asarray(loss)
+    dt = time.perf_counter() - t0
+    tokens_per_sec = batch * toks * steps / dt
+    mfu = (flops_per_example * batch * steps / dt) / _peak_flops(
+        jax.devices()[0])
+    print(json.dumps({
+        "metric": "bert_base_dygraph_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec",
+        "vs_baseline": round(mfu / 0.45, 4),
+    }))
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=os.environ.get("BENCH_MODEL",
                                                       "transformer"),
                     choices=["transformer", "bert", "resnet50"])
+    ap.add_argument("--dygraph", action="store_true",
+                    default=os.environ.get("BENCH_DYGRAPH", "") == "1",
+                    help="route bert through the dygraph build")
     args = ap.parse_args()
 
     import jax
     import paddle_tpu as fluid
 
     on_tpu = jax.devices()[0].platform == "tpu"
+
+    if args.model == "bert" and args.dygraph:
+        return _bench_bert_dygraph(on_tpu)
 
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):

@@ -67,6 +67,33 @@ def _conv3d(env, op):
     put(env, op.output("Output"), out)
 
 
+def conv_transpose_nchw(x, w, strides, pads, dil, groups=1):
+    """Transposed conv as a fractionally-strided conv (the reference
+    kernel's semantics, ``conv_transpose_op.cc``): w is IOHW
+    [Cin, Cout/groups, kh, kw]; output spatial = (i-1)*s - 2p + d*(k-1)+1.
+    lhs_dilation inserts the stride zeros; the kernel is spatially flipped
+    and I/O-swapped per group into OIHW."""
+    cin = w.shape[0]
+    cog = w.shape[1]  # Cout / groups
+    wf = jnp.flip(w, axis=(2, 3))
+    if groups == 1:
+        wt = wf.transpose(1, 0, 2, 3)  # [Cout, Cin, kh, kw]
+    else:
+        wg = wf.reshape((groups, cin // groups, cog) + w.shape[2:])
+        wt = wg.transpose(0, 2, 1, 3, 4).reshape(
+            (groups * cog, cin // groups) + w.shape[2:])
+    kh = (w.shape[2] - 1) * dil[0] + 1
+    kw = (w.shape[3] - 1) * dil[1] + 1
+    return jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1),
+        padding=[(kh - 1 - pads[0], kh - 1 - pads[0]),
+                 (kw - 1 - pads[1], kw - 1 - pads[1])],
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
 @register("conv2d_transpose")
 def _conv2d_transpose(env, op):
     x = get(env, op.input("Input"))
@@ -74,15 +101,11 @@ def _conv2d_transpose(env, op):
     strides = _pair(op.attr("strides", [1, 1]))
     pads = _pair(op.attr("paddings", [0, 0]))
     dil = _pair(op.attr("dilations", [1, 1]))
-    out = jax.lax.conv_transpose(
-        x, w,
-        strides=strides,
-        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
-        rhs_dilation=dil,
-        dimension_numbers=("NCHW", "IOHW", "NCHW"),
-        transpose_kernel=True,
-    )
-    put(env, op.output("Output"), out)
+    from ..op_registry import mxu_cast
+    x, w = mxu_cast(x, w)
+    put(env, op.output("Output"),
+        conv_transpose_nchw(x, w, strides, pads, dil,
+                            op.attr("groups", 1) or 1))
 
 
 # ---------------- pooling ----------------

@@ -18,6 +18,25 @@ import numpy as np
 _dygraph_tracer = None
 _grad_enabled = True
 
+# explicit randomness stream for jit-safe stochastic layers (Dropout):
+# under Layer.functional(..., rng=True) the apply function seeds this per
+# call, so every trace/step draws fresh, reproducible keys instead of a
+# trace-frozen module key
+_rng_stream = [None]
+
+
+def set_rng(key):
+    _rng_stream[0] = key
+
+
+def next_key():
+    """Next key from the explicit stream, or None when unseeded (legacy
+    eager behavior: layers fall back to their module-level key)."""
+    if _rng_stream[0] is None:
+        return None
+    _rng_stream[0], sub = jax.random.split(_rng_stream[0])
+    return sub
+
 
 def _in_dygraph_mode():
     return _dygraph_tracer is not None

@@ -145,20 +145,31 @@ class Layer:
             if p.name in tree:
                 p._value = jnp.asarray(tree[p.name])
 
-    def functional(self):
+    def functional(self, rng=False):
         """Return (apply_fn, params) where apply_fn(params, *inputs) swaps the
-        pytree into the parameters and runs forward — jit/grad-safe."""
+        pytree into the parameters and runs forward — jit/grad-safe.
+        With ``rng=True`` the signature is ``apply_fn(params, key, *inputs)``
+        and stochastic layers (Dropout) draw fresh keys from ``key`` each
+        call instead of a trace-frozen module key."""
+        from . import base
+
         params0 = self.state_pytree()
         plist = self.parameters()
 
         def apply_fn(params, *inputs):
             saved = [p._value for p in plist]
+            if rng:
+                key, inputs = inputs[0], inputs[1:]
             try:
+                if rng:
+                    base.set_rng(key)
                 for p in plist:
                     p._value = params[p.name]
                 out = self.forward(*[to_variable(i) for i in inputs])
                 return out.value() if isinstance(out, VarBase) else out
             finally:
+                if rng:
+                    base.set_rng(None)
                 for p, s in zip(plist, saved):
                     p._value = s
 

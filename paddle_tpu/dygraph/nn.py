@@ -11,8 +11,10 @@ from ..core.initializer import (ConstantInitializer, NormalInitializer,
 from .base import VarBase, record, to_variable
 from .layers import Layer
 
-__all__ = ["FC", "Linear", "Conv2D", "Pool2D", "BatchNorm", "Embedding",
-           "LayerNorm", "Dropout", "PRelu", "GRUUnit"]
+__all__ = ["FC", "Linear", "Conv2D", "Conv2DTranspose", "Pool2D",
+           "BatchNorm", "GroupNorm", "SpectralNorm", "Embedding",
+           "LayerNorm", "Dropout", "PRelu", "GRUUnit",
+           "BilinearTensorProduct", "NCE"]
 
 
 class FC(Layer):
@@ -232,10 +234,14 @@ class Dropout(Layer):
         self._p = p
 
     def forward(self, x):
+        from . import base
+
         x = to_variable(x)
         if not self.training or self._p == 0.0:
             return x
-        Dropout._key, sub = jax.random.split(Dropout._key)
+        sub = base.next_key()
+        if sub is None:  # legacy eager stream
+            Dropout._key, sub = jax.random.split(Dropout._key)
         p = self._p
 
         def fn(xv):
@@ -254,6 +260,186 @@ class PRelu(Layer):
     def forward(self, x):
         return record(lambda xv, a: jnp.where(xv > 0, xv, a * xv),
                       to_variable(x), self._alpha)
+
+
+class Conv2DTranspose(Layer):
+    """Ref ``imperative/nn.py``-era Conv2DTranspose wrapping
+    ``conv2d_transpose_op`` (IOHW kernel layout)."""
+
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=3, stride=1, padding=0, act=None,
+                 dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+
+        def pair(v):
+            return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+        self._stride, self._pad = pair(stride), pair(padding)
+        self._act = act
+        fs = pair(filter_size)
+        self._w = self.create_parameter(
+            [num_channels, num_filters, fs[0], fs[1]])
+        self._b = self.create_parameter([num_filters], is_bias=True)
+
+    def forward(self, x):
+        from ..core.opimpl.nn_ops import conv_transpose_nchw
+
+        s, p, act = self._stride, self._pad, self._act
+
+        def fn(xv, w, b):
+            out = conv_transpose_nchw(xv, w, s, p, (1, 1))
+            out = out + b.reshape(1, -1, 1, 1)
+            if act:
+                out = getattr(jax.nn, act)(out)
+            return out
+
+        return record(fn, to_variable(x), self._w, self._b)
+
+
+class GroupNorm(Layer):
+    """Ref ``group_norm_op`` as a module (NCHW)."""
+
+    def __init__(self, name_scope=None, channels=None, groups=1,
+                 epsilon=1e-5, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._eps = epsilon
+        self._scale = self.create_parameter(
+            [channels], initializer=ConstantInitializer(1.0))
+        self._bias = self.create_parameter([channels], is_bias=True)
+
+    def forward(self, x):
+        g, eps = self._groups, self._eps
+
+        def fn(xv, scale, bias):
+            n, c = xv.shape[0], xv.shape[1]
+            xg = xv.reshape((n, g, c // g) + xv.shape[2:])
+            axes = tuple(range(2, xg.ndim))
+            mu = jnp.mean(xg, axis=axes, keepdims=True)
+            var = jnp.var(xg, axis=axes, keepdims=True)
+            y = ((xg - mu) * jax.lax.rsqrt(var + eps)).reshape(xv.shape)
+            cshape = (1, c) + (1,) * (xv.ndim - 2)
+            return y * scale.reshape(cshape) + bias.reshape(cshape)
+
+        return record(fn, to_variable(x), self._scale, self._bias)
+
+
+class SpectralNorm(Layer):
+    """Ref ``spectral_norm_op``: weight / sigma_max via power iteration
+    (u, v buffers advance eagerly per call, matching the op's in-place
+    U/V update)."""
+
+    def __init__(self, name_scope=None, weight_shape=None, dim=0,
+                 power_iters=1, eps=1e-12, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._dim = dim
+        self._iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        # U/V are NO-GRAD buffers (ref spectral_norm_op: persistable
+        # state advanced by the kernel, never optimizer-updated) — plain
+        # arrays, not registered parameters
+        key = jax.random.PRNGKey(17)
+        ku, kv = jax.random.split(key)
+        self._u = jax.random.normal(ku, (h,), jnp.float32)
+        self._v = jax.random.normal(kv, (w,), jnp.float32)
+
+    def forward(self, weight):
+        weight = to_variable(weight)
+        dim, iters, eps = self._dim, self._iters, self._eps
+
+        # power iteration with the CURRENT buffers; sigma's u, v are
+        # constants w.r.t. the gradient (the reference grad kernel treats
+        # them as fixed vectors), so they enter fn by closure, not as
+        # differentiable inputs
+        wv = weight.value()
+        wm0 = jax.lax.stop_gradient(
+            jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1))
+        u, v = self._u, self._v
+        for _ in range(iters):
+            v = wm0.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm0 @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        if not isinstance(wv, jax.core.Tracer):
+            # eager: advance the buffers; under jit the advance is part of
+            # the trace only (buffers hold concrete values across steps)
+            self._u, self._v = u, v
+
+        def fn(w_in):
+            wm = jnp.moveaxis(w_in, dim, 0).reshape(w_in.shape[dim], -1)
+            sigma = u @ wm @ v
+            return w_in / sigma
+
+        return record(fn, weight)
+
+
+class BilinearTensorProduct(Layer):
+    """Ref ``bilinear_tensor_product_op``: out_k = x^T W_k y + b_k."""
+
+    def __init__(self, name_scope=None, input1_dim=None, input2_dim=None,
+                 output_dim=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self._w = self.create_parameter(
+            [output_dim, input1_dim, input2_dim])
+        self._b = self.create_parameter([output_dim], is_bias=True)
+
+    def forward(self, x, y):
+        act = self._act
+
+        def fn(xv, yv, w, b):
+            out = jnp.einsum("bi,kij,bj->bk", xv, w, yv) + b
+            if act:
+                out = getattr(jax.nn, act)(out)
+            return out
+
+        return record(fn, to_variable(x), to_variable(y), self._w, self._b)
+
+
+class NCE(Layer):
+    """Ref ``imperative`` NCE wrapping ``nce_op``: noise-contrastive loss
+    with uniform negative sampling."""
+
+    def __init__(self, name_scope=None, num_total_classes=None, dim=None,
+                 num_neg_samples=10, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._n_classes = num_total_classes
+        self._n_neg = num_neg_samples
+        self._w = self.create_parameter([num_total_classes, dim])
+        self._b = self.create_parameter([num_total_classes], is_bias=True)
+
+    _key = jax.random.PRNGKey(4321)
+
+    def forward(self, x, label):
+        from . import base
+
+        n_cls, n_neg = self._n_classes, self._n_neg
+        sub = base.next_key()
+        if sub is None:  # own eager stream, independent of Dropout's
+            NCE._key, sub = jax.random.split(NCE._key)
+        label = VarBase(to_variable(label).value(), stop_gradient=True)
+
+        def fn(xv, lv, w, b):
+            lv = lv.reshape(-1).astype(jnp.int32)
+            bsz = xv.shape[0]
+            neg = jax.random.randint(sub, (bsz, n_neg), 0, n_cls)
+            pos_logit = jnp.sum(xv * w[lv], axis=-1) + b[lv]
+            neg_logit = jnp.einsum("bd,bnd->bn", xv, w[neg]) + b[neg]
+            # uniform noise distribution q = 1/n_classes
+            log_q = -jnp.log(float(n_cls))
+            pos_loss = -jax.nn.log_sigmoid(pos_logit - log_q)
+            neg_loss = -jnp.sum(
+                jax.nn.log_sigmoid(-(neg_logit - log_q)), axis=-1)
+            return (pos_loss + neg_loss).reshape(-1, 1)
+
+        return record(fn, to_variable(x), label, self._w, self._b)
 
 
 class GRUUnit(Layer):
