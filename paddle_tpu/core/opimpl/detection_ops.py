@@ -922,3 +922,96 @@ def _roi_perspective_transform(env, op):
         return out * inside[None].astype(out.dtype)
 
     put(env, op.output("Out"), jax.vmap(one)(rois))
+
+
+def _point_in_polys(polys, px, py):
+    """Even-odd rasterization: ``polys`` [P, V, 2] (degenerate repeated-
+    point padding contributes nothing), ``px``/``py`` [M, M] sample
+    points. Returns bool [M, M] — inside the union of the P polygons."""
+    v1 = polys                      # [P, V, 2]
+    v2 = jnp.roll(polys, -1, axis=1)
+    x1 = v1[..., 0][:, :, None, None]
+    y1 = v1[..., 1][:, :, None, None]
+    x2 = v2[..., 0][:, :, None, None]
+    y2 = v2[..., 1][:, :, None, None]
+    pxb = px[None, None]
+    pyb = py[None, None]
+    straddles = (y1 <= pyb) != (y2 <= pyb)
+    # x coordinate where the edge crosses the horizontal line through py
+    t = (pyb - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    cross_x = x1 + t * (x2 - x1)
+    crossings = jnp.sum((straddles & (pxb < cross_x)).astype(jnp.int32),
+                       axis=1)  # [P, M, M]
+    return jnp.any(crossings % 2 == 1, axis=0)
+
+
+@register("generate_mask_labels")
+def _generate_mask_labels(env, op):
+    """Ref ``detection/generate_mask_labels_op.cc`` (+ ``mask_util.cc``
+    Polys2MaskWrtBox): associate each foreground RoI with the gt mask of
+    highest bbox overlap and rasterize its polygons into a class-specific
+    [resolution, resolution] target.
+
+    Fixed-shape re-design (the reference kernel is CPU-pinned and
+    LoD-variadic): GtSegms is [N, G, P, V, 2] with degenerate repeated-
+    point padding; outputs keep the RoI axis — MaskRois [N, R, 4],
+    RoiHasMaskInt32 [N, R] (1 = fg row carries a target, the redesign of
+    the reference's fg index list), MaskInt32 [N, R, C*M*M] with -1
+    ignore labels outside each fg row's class segment. Rasterization is
+    even-odd point-in-polygon at pixel centers (subpixel boundary
+    handling may differ from the reference's RLE scanline by <=1px)."""
+    im_info = get(env, op.input("ImInfo"))                  # [N, 3]
+    gt_cls = get(env, op.input("GtClasses")).astype(jnp.int32)   # [N, G]
+    is_crowd = get(env, op.input("IsCrowd")).astype(jnp.int32)   # [N, G]
+    segms = get(env, op.input("GtSegms")).astype(jnp.float32)  # [N,G,P,V,2]
+    rois = get(env, op.input("Rois"))                       # [N, R, 4]
+    labels = get(env, op.input("LabelsInt32")).astype(jnp.int32)  # [N, R]
+    num_classes = int(op.attr("num_classes"))
+    m = int(op.attr("resolution"))
+
+    def one(info, cls_i, crowd_i, segms_i, rois_i, lab_i):
+        scale = info[2]
+        valid_gt = (cls_i > 0) & (crowd_i == 0)
+        pts = segms_i.reshape(segms_i.shape[0], -1, 2)      # [G, P*V, 2]
+        gx1 = jnp.min(pts[..., 0], axis=1)
+        gy1 = jnp.min(pts[..., 1], axis=1)
+        gx2 = jnp.max(pts[..., 0], axis=1)
+        gy2 = jnp.max(pts[..., 1], axis=1)
+        poly_boxes = jnp.stack([gx1, gy1, gx2, gy2], axis=1)  # [G, 4]
+
+        fg = lab_i > 0
+        rois_im = rois_i / jnp.maximum(scale, 1e-8)  # image coords
+        iou = _iou_matrix(rois_im, poly_boxes, norm=False)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        match = jnp.argmax(iou, axis=1)              # [R]
+
+        jj, ii = jnp.meshgrid(jnp.arange(m), jnp.arange(m), indexing="xy")
+
+        def rasterize(roi, gt_idx):
+            x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+            w = jnp.maximum(x2 - x1, 1.0)
+            h = jnp.maximum(y2 - y1, 1.0)
+            polys = segms_i[gt_idx]                  # [P, V, 2]
+            # transform polygons into the M-grid of the roi box
+            tx = (polys[..., 0] - x1) * m / w
+            ty = (polys[..., 1] - y1) * m / h
+            tp = jnp.stack([tx, ty], axis=-1)
+            return _point_in_polys(tp, jj + 0.5, ii + 0.5)
+
+        masks = jax.vmap(rasterize)(rois_im, match)  # [R, m, m] bool
+        mask_flat = masks.reshape(rois_i.shape[0], m * m).astype(jnp.int32)
+
+        # expand to class-specific segments, -1 = ignore
+        seg_ids = jnp.arange(num_classes * m * m) // (m * m)  # [C*M*M]
+        expanded = jnp.where(
+            fg[:, None] & (seg_ids[None, :] == lab_i[:, None]),
+            jnp.tile(mask_flat, (1, num_classes)),
+            -1)
+        mask_rois = jnp.where(fg[:, None], rois_i, 0.0)
+        return mask_rois, fg.astype(jnp.int32), expanded
+
+    mask_rois, has_mask, mask_int = jax.vmap(one)(
+        im_info, gt_cls, is_crowd, segms, rois, labels)
+    put(env, op.output("MaskRois"), mask_rois)
+    put(env, op.output("RoiHasMaskInt32"), has_mask)
+    put(env, op.output("MaskInt32"), mask_int)

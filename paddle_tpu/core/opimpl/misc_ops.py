@@ -146,55 +146,79 @@ def _edit_distance(env, op):
     put(env, op.output("SequenceNum"), jnp.asarray(b, jnp.int32))
 
 
+def _chunk_marks(tags, valid, scheme, num_types):
+    """Per-position (begin, end, type) flags for CoNLL-style chunking
+    (ref ``chunk_eval_op.h`` ChunkEvalKernel::IsChunkBegin/End).
+    ``tags`` [B, T]; type = tag // num_tag_types, other = out of range."""
+    n_tags = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    typ = jnp.where((tags >= 0) & (tags < num_types * n_tags),
+                    tags // n_tags, -1)
+    typ = jnp.where(valid, typ, -1)
+    role = tags % n_tags
+    # neighbors (other beyond the edges)
+    prev_t = jnp.pad(typ[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    next_t = jnp.pad(typ[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+    prev_r = jnp.pad(role[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    next_r = jnp.pad(role[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+    in_chunk = typ >= 0
+    if scheme == "plain":
+        begin = in_chunk & (prev_t != typ)
+        end = in_chunk & (next_t != typ)
+    elif scheme == "IOB":  # 0=B, 1=I
+        begin = in_chunk & ((role == 0) | (prev_t != typ))
+        end = in_chunk & ((next_t != typ) | (next_r == 0))
+    elif scheme == "IOE":  # 0=I, 1=E
+        begin = in_chunk & ((prev_t != typ) | (prev_r == 1))
+        end = in_chunk & ((role == 1) | (next_t != typ))
+    else:  # IOBES: 0=B, 1=I, 2=E, 3=S
+        begin = in_chunk & ((role == 0) | (role == 3) | (prev_t != typ))
+        end = in_chunk & ((role == 2) | (role == 3) | (next_t != typ))
+    return begin, end, typ
+
+
+def _next_end_pos(end):
+    """Position of the first chunk end at or after each position (reverse
+    running minimum), +T for none. end: bool [B, T]."""
+    b, t = end.shape
+    pos = jnp.where(end, jnp.arange(t)[None, :], t)
+    return jax.lax.associative_scan(jnp.minimum, pos[:, ::-1],
+                                    axis=1)[:, ::-1]
+
+
 @register("chunk_eval")
 def _chunk_eval(env, op):
-    """Ref ``chunk_eval_op.cc`` (IOB scheme): chunk-level precision /
-    recall / F1 for sequence labeling, masked by lengths.
+    """Ref ``chunk_eval_op.cc``: chunk-level precision / recall / F1 for
+    sequence labeling under the plain/IOB/IOE/IOBES schemes, with
+    ``excluded_chunk_types`` support, masked by lengths.
 
-    Tag encoding (the reference's IOB layout): ``type*2`` = B-type,
-    ``type*2 + 1`` = I-type, ``num_chunk_types*2`` = O. A predicted chunk
-    is correct iff start, type AND end all match the label chunk."""
+    Static-shape formulation: per-position begin/end/type flags; an
+    inference chunk is correct iff the label sequence begins a chunk at
+    the same position with the same type AND both chunks end at the same
+    position (first end >= begin, matching the reference's
+    start+type+end equality)."""
     inf = get(env, op.input("Inference")).astype(jnp.int32)  # [B, T]
     lbl = get(env, op.input("Label")).astype(jnp.int32)
     length = get(env, op.input("SeqLength")).reshape(-1).astype(jnp.int32)
-    num_chunk_types = op.attr("num_chunk_types")
+    num_types = op.attr("num_chunk_types")
+    scheme = op.attr("chunk_scheme", "IOB")
+    excluded = tuple(op.attr("excluded_chunk_types", ()) or ())
+    if inf.ndim == 1:
+        inf = inf[None, :]
+        lbl = lbl[None, :]
     b, t = inf.shape
-    pos = jnp.arange(t)[None, :]
-    valid = pos < length[:, None]
+    valid = jnp.arange(t)[None, :] < length[:, None]
 
-    def is_b(seq):
-        return (seq % 2 == 0) & (seq < num_chunk_types * 2) & valid
-
-    def is_i_of(seq, typ):
-        return seq == typ * 2 + 1
-
-    inf_b, lbl_b = is_b(inf), is_b(lbl)
-    n_inf = jnp.sum(inf_b.astype(jnp.int32))
-    n_lbl = jnp.sum(lbl_b.astype(jnp.int32))
-
-    # scan state per batch row: (open: matching chunk in progress,
-    # typ: its type, cnt). A chunk closes when the continuation (I-of-
-    # type) stops in either sequence; it counts iff both stop TOGETHER.
-    def step(carry, j):
-        open_, typ, cnt = carry
-        inf_j, lbl_j = inf[:, j], lbl[:, j]
-        inf_cont = is_i_of(inf_j, typ) & valid[:, j]
-        lbl_cont = is_i_of(lbl_j, typ) & valid[:, j]
-        both_end = open_ & ~inf_cont & ~lbl_cont
-        mismatch = open_ & (inf_cont != lbl_cont)
-        cnt = cnt + both_end.astype(jnp.int32)
-        open_ = open_ & ~both_end & ~mismatch
-        # a new matching chunk starts here (only if not continuing one)
-        start = (~open_ & inf_b[:, j] & lbl_b[:, j]
-                 & (inf_j == lbl_j))
-        typ = jnp.where(start, inf_j // 2, typ)
-        open_ = open_ | start
-        return (open_, typ, cnt), None
-
-    init = (jnp.zeros((b,), bool), jnp.zeros((b,), jnp.int32),
-            jnp.zeros((b,), jnp.int32))
-    (open_, _, cnt), _ = jax.lax.scan(step, init, jnp.arange(t))
-    n_correct = jnp.sum(cnt + open_.astype(jnp.int32))
+    ib, ie, ityp = _chunk_marks(inf, valid, scheme, num_types)
+    lb, le, ltyp = _chunk_marks(lbl, valid, scheme, num_types)
+    if excluded:
+        exc = jnp.asarray(excluded, jnp.int32)
+        ib = ib & ~jnp.any(ityp[..., None] == exc, axis=-1)
+        lb = lb & ~jnp.any(ltyp[..., None] == exc, axis=-1)
+    n_inf = jnp.sum(ib.astype(jnp.int32))
+    n_lbl = jnp.sum(lb.astype(jnp.int32))
+    correct = (ib & lb & (ityp == ltyp)
+               & (_next_end_pos(ie) == _next_end_pos(le)))
+    n_correct = jnp.sum(correct.astype(jnp.int32))
     p = n_correct / jnp.maximum(n_inf, 1)
     r = n_correct / jnp.maximum(n_lbl, 1)
     f1 = 2 * p * r / jnp.maximum(p + r, 1e-8)
@@ -301,7 +325,24 @@ def _pool_with_index(env, op):
     """Ref ``pool_with_index_op.cc`` (max_pool2d_with_index). Mask holds
     flat indices into the UNPADDED input (-inf padding never wins)."""
     if op.attr("adaptive", False):
-        raise NotImplementedError("pool_with_index: adaptive mode")
+        # equal-bin adaptive mode (ref AdaptiveStartIndex/EndIndex with
+        # divisible dims): reshape into bins, argmax per bin
+        x = get(env, op.input("X"))
+        n, c, h, w = x.shape
+        oh, ow = op.attr("ksize")[0], op.attr("ksize")[1]
+        assert h % oh == 0 and w % ow == 0, \
+            "adaptive pool_with_index needs divisible dims"
+        bh, bw = h // oh, w // ow
+        xr = x.reshape(n, c, oh, bh, ow, bw).transpose(0, 1, 2, 4, 3, 5) \
+            .reshape(n, c, oh, ow, bh * bw)
+        arg = jnp.argmax(xr, axis=-1)
+        out = jnp.max(xr, axis=-1)
+        by, bx = arg // bw, arg % bw
+        gy = jnp.arange(oh)[None, None, :, None] * bh + by
+        gx = jnp.arange(ow)[None, None, None, :] * bw + bx
+        put(env, op.output("Out"), out)
+        put(env, op.output("Mask"), (gy * w + gx).astype(jnp.int32))
+        return
     x = get(env, op.input("X"))
     n, c, h, w = x.shape
     ks = op.attr("ksize")
@@ -328,6 +369,62 @@ def _pool_with_index(env, op):
     gx = jnp.arange(ow)[None, None, None, :] * sw + kx - pw_
     put(env, op.output("Out"), out)
     put(env, op.output("Mask"), (gy * w + gx).astype(jnp.int32))
+
+
+@register("max_pool3d_with_index")
+def _max_pool3d_with_index(env, op):
+    """Ref ``max_pool_with_index_op.cc`` 3-D variant (NCDHW): max pool +
+    flat argmax indices into the unpadded D*H*W volume."""
+    x = get(env, op.input("X"))
+    n, c, d, h, w = x.shape
+    ks = list(op.attr("ksize"))
+    if op.attr("global_pooling", False):
+        ks = [d, h, w]
+    if op.attr("adaptive", False):
+        od, oh, ow = ks
+        assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+            "adaptive max_pool3d_with_index needs divisible dims"
+        bd, bh, bw = d // od, h // oh, w // ow
+        xr = x.reshape(n, c, od, bd, oh, bh, ow, bw) \
+            .transpose(0, 1, 2, 4, 6, 3, 5, 7) \
+            .reshape(n, c, od, oh, ow, bd * bh * bw)
+        arg = jnp.argmax(xr, axis=-1)
+        out = jnp.max(xr, axis=-1)
+        bz = arg // (bh * bw)
+        by = (arg % (bh * bw)) // bw
+        bx = arg % bw
+        gz = jnp.arange(od)[None, None, :, None, None] * bd + bz
+        gy = jnp.arange(oh)[None, None, None, :, None] * bh + by
+        gx = jnp.arange(ow)[None, None, None, None, :] * bw + bx
+        put(env, op.output("Out"), out)
+        put(env, op.output("Mask"),
+            ((gz * h + gy) * w + gx).astype(jnp.int32))
+        return
+    strides = list(op.attr("strides", ks))
+    pads = list(op.attr("paddings", [0, 0, 0]))
+    pd_, ph_, pw_ = pads[0], pads[1], pads[2]
+    if pd_ or ph_ or pw_:
+        x = jnp.pad(x, ((0, 0), (0, 0), (pd_, pd_), (ph_, ph_),
+                        (pw_, pw_)), constant_values=-jnp.inf)
+    dp, hp, wp = x.shape[2], x.shape[3], x.shape[4]
+    kd, kh, kw = ks
+    sd, sh, sw = strides
+    od = (dp - kd) // sd + 1
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    wins = jnp.stack([
+        x[:, :, a:a + sd * od:sd, i:i + sh * oh:sh, j:j + sw * ow:sw]
+        for a in range(kd) for i in range(kh) for j in range(kw)], axis=-1)
+    arg = jnp.argmax(wins, axis=-1)
+    out = jnp.max(wins, axis=-1)
+    kz = arg // (kh * kw)
+    ky = (arg % (kh * kw)) // kw
+    kx = arg % kw
+    gz = jnp.arange(od)[None, None, :, None, None] * sd + kz - pd_
+    gy = jnp.arange(oh)[None, None, None, :, None] * sh + ky - ph_
+    gx = jnp.arange(ow)[None, None, None, None, :] * sw + kx - pw_
+    put(env, op.output("Out"), out)
+    put(env, op.output("Mask"), ((gz * h + gy) * w + gx).astype(jnp.int32))
 
 
 @register("unpool")
@@ -406,23 +503,23 @@ def _spp(env, op):
 def _similarity_focus(env, op):
     """Ref ``similarity_focus_op.cc``: focus mask from max positions of
     selected channels."""
-    x = get(env, op.input("X"))  # [N, C, A, B]
+    x = get(env, op.input("X"))  # [N, d1, d2, d3], axis in {1, 2, 3}
     axis = op.attr("axis")
     indexes = op.attr("indexes")
-    if axis != 1:
-        raise NotImplementedError(
-            "similarity_focus: axis=%d not implemented (axis=1 only); "
-            "transpose the input instead" % axis)
-    n, c, a, bdim = x.shape
-    mask = jnp.zeros_like(x)
+    if axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: axis must be 1, 2 or 3")
+    # normalize to the axis=1 layout, compute, and restore
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    inv = tuple(perm.index(i) for i in range(4))
+    xt = jnp.transpose(x, perm)
+    mask = jnp.zeros_like(xt)
     for idx in indexes:
-        if axis == 1:
-            sel = x[:, idx]  # [N, A, B]
-            ra = jnp.max(sel, axis=2, keepdims=True) == sel
-            rb = jnp.max(sel, axis=1, keepdims=True) == sel
-            m = (ra | rb).astype(x.dtype)[:, None]
-            mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
-    put(env, op.output("Out"), mask)
+        sel = xt[:, idx]  # [N, A, B]
+        ra = jnp.max(sel, axis=2, keepdims=True) == sel
+        rb = jnp.max(sel, axis=1, keepdims=True) == sel
+        m = (ra | rb).astype(xt.dtype)[:, None]
+        mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
+    put(env, op.output("Out"), jnp.transpose(mask, inv))
 
 
 @register("spectral_norm")

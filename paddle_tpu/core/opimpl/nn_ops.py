@@ -163,6 +163,106 @@ def _pool2d(env, op):
     put(env, op.output("Out"), out)
 
 
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register("pool3d")
+def _pool3d(env, op):
+    """Ref ``pool_op.cc`` pool3d (NCDHW): max/avg over 3-D windows with
+    ceil_mode / exclusive / adaptive / global parity."""
+    x = get(env, op.input("X"))  # NCDHW
+    ptype = op.attr("pooling_type", "max")
+    ksize = _triple(op.attr("ksize"))
+    strides = _triple(op.attr("strides", [1, 1, 1]))
+    pads = _triple(op.attr("paddings", [0, 0, 0]))
+    if op.attr("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        put(env, op.output("Out"), red(x, axis=(2, 3, 4), keepdims=True))
+        return
+    if op.attr("adaptive", False):
+        n, c, d, h, w = x.shape
+        od, oh, ow = ksize
+        assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+            "adaptive pool3d needs divisible dims"
+        xr = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        put(env, op.output("Out"), red(xr, axis=(3, 5, 7)))
+        return
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if op.attr("ceil_mode", False):
+        n, c = x.shape[:2]
+        for i, (sp, kk, st, pp) in enumerate(zip(x.shape[2:], ksize,
+                                                 strides, pads)):
+            out_i = -(-(sp + 2 * pp - kk) // st) + 1
+            need = (out_i - 1) * st + kk - (sp + 2 * pp)
+            pad_cfg[2 + i] = (pp, pp + max(0, need))
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride,
+                                    pad_cfg)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
+                                  pad_cfg)
+        if op.attr("exclusive", True) and (any(pads)
+                                           or op.attr("ceil_mode", False)):
+            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                        window, stride, pad_cfg)
+            out = s / cnt
+        else:
+            out = s / float(ksize[0] * ksize[1] * ksize[2])
+    put(env, op.output("Out"), out)
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(env, op):
+    """Ref ``conv_transpose_op.cc`` conv3d_transpose (NCDHW, IODHW
+    kernel): fractionally-strided conv, like the 2-D case."""
+    x = get(env, op.input("Input"))
+    w = get(env, op.input("Filter"))  # [Cin, Cout/g, kd, kh, kw]
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    d = _triple(op.attr("dilations", [1, 1, 1]))
+    groups = op.attr("groups", 1) or 1
+    from ..op_registry import mxu_cast
+    x, w = mxu_cast(x, w)
+    cin, cog = w.shape[0], w.shape[1]
+    wf = jnp.flip(w, axis=(2, 3, 4))
+    if groups == 1:
+        wt = wf.transpose(1, 0, 2, 3, 4)
+    else:
+        wg = wf.reshape((groups, cin // groups, cog) + w.shape[2:])
+        wt = wg.transpose(0, 2, 1, 3, 4, 5).reshape(
+            (groups * cog, cin // groups) + w.shape[2:])
+    kd = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(3)]
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1, 1),
+        padding=[(kd[i] - 1 - p[i], kd[i] - 1 - p[i]) for i in range(3)],
+        lhs_dilation=s, rhs_dilation=d,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    put(env, op.output("Output"), out)
+
+
+@register("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(env, op):
+    """Ref ``conv_transpose_op.cc`` depthwise variant: groups == Cin."""
+    x = get(env, op.input("Input"))
+    w = get(env, op.input("Filter"))
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    from ..op_registry import mxu_cast
+    x, w = mxu_cast(x, w)
+    put(env, op.output("Output"),
+        conv_transpose_nchw(x, w, strides, pads, dil, groups=x.shape[1]))
+
+
 # ---------------- normalization ----------------
 
 @register("batch_norm")

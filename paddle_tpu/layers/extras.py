@@ -262,14 +262,10 @@ def random_crop(x, shape, seed=None, name=None):
 
 def chunk_eval(input, label, chunk_scheme, num_chunk_types, seq_length,
                excluded_chunk_types=None):
-    """IOB-scheme chunk metrics (ref ``layers/nn.py`` chunk_eval)."""
-    if chunk_scheme != "IOB":
-        raise NotImplementedError("chunk_eval: scheme %r (IOB supported)"
-                                  % chunk_scheme)
-    if excluded_chunk_types:
-        raise NotImplementedError(
-            "chunk_eval: excluded_chunk_types is not implemented — "
-            "remap the excluded types to O tags before calling")
+    """Chunk metrics (ref ``layers/nn.py`` chunk_eval): plain / IOB /
+    IOE / IOBES schemes, optional ``excluded_chunk_types``."""
+    if chunk_scheme not in ("plain", "IOB", "IOE", "IOBES"):
+        raise ValueError("chunk_eval: unknown scheme %r" % chunk_scheme)
     helper = LayerHelper("chunk_eval")
     outs = {}
     for n, dt in (("Precision", "float32"), ("Recall", "float32"),
@@ -281,7 +277,10 @@ def chunk_eval(input, label, chunk_scheme, num_chunk_types, seq_length,
     helper.append_op("chunk_eval",
                      {"Inference": input, "Label": label,
                       "SeqLength": seq_length},
-                     outs, {"num_chunk_types": num_chunk_types})
+                     outs, {"num_chunk_types": num_chunk_types,
+                            "chunk_scheme": chunk_scheme,
+                            "excluded_chunk_types":
+                                list(excluded_chunk_types or ())})
     return (outs["Precision"], outs["Recall"], outs["F1-Score"],
             outs["NumInferChunks"], outs["NumLabelChunks"],
             outs["NumCorrectChunks"])

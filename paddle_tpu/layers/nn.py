@@ -1259,6 +1259,208 @@ def dynamic_lstm(input, size, lengths=None, h_0=None, c_0=None,
     return hidden, cell
 
 
+def dynamic_lstmp(input, size, proj_size, lengths=None, h_0=None, c_0=None,
+                  param_attr=None, bias_attr=None, use_peepholes=False,
+                  is_reverse=False, gate_activation="sigmoid",
+                  cell_activation="tanh", candidate_activation="tanh",
+                  proj_activation="tanh", cell_clip=0.0, proj_clip=0.0,
+                  dtype=None, name=None):
+    """Projection LSTM (ref ``nn.py`` dynamic_lstmp / ``lstmp_op.cc``):
+    the recurrent state is the P-dim projection of the hidden state.
+    ``input`` is ``[B, T, 4H]`` pre-projected; returns
+    ``(projection [B,T,P], cell [B,T,H])``."""
+    helper = LayerHelper("dynamic_lstmp", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    hidden_size = size // 4
+    dtype = dtype or _dtype(input)
+    w = helper.create_parameter(helper.param_attr,
+                                shape=[proj_size, 4 * hidden_size],
+                                dtype=dtype)
+    import copy as _copy
+    pattr = ParamAttr._to_attr(param_attr)
+    pattr = _copy.copy(pattr)
+    if pattr.name is not None:
+        pattr.name = pattr.name + "_proj"
+    wp = helper.create_parameter(pattr, shape=[hidden_size, proj_size],
+                                 dtype=dtype)
+    b = helper.create_parameter(helper.bias_attr, shape=[4 * hidden_size],
+                                dtype=dtype, is_bias=True)
+    b_sz, t_sz = input.shape[0], input.shape[1]
+    proj = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(b_sz, t_sz, proj_size))
+    cell = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(b_sz, t_sz, hidden_size))
+    inputs = {"Input": input, "Weight": w, "ProjWeight": wp, "Bias": b}
+    if lengths is not None:
+        inputs["Lengths"] = lengths
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op("lstmp_seq", inputs,
+                     {"Projection": proj, "Cell": cell},
+                     {"is_reverse": is_reverse, "cell_clip": cell_clip,
+                      "proj_clip": proj_clip,
+                      "proj_activation": proj_activation})
+    return proj, cell
+
+
+def attention_lstm(input, size, lengths=None, h_0=None, c_0=None,
+                   param_attr=None, bias_attr=None, name=None):
+    """Attention LSTM (ref ``attention_lstm_op.cc``): each step attends
+    over the whole sequence with c_{t-1} and feeds the pooled vector to
+    an LSTM cell. ``input`` [B, T, M]; returns (hidden [B,T,D], cell)."""
+    helper = LayerHelper("attention_lstm", param_attr=param_attr,
+                         bias_attr=bias_attr, name=name)
+    d = size
+    m = int(input.shape[-1])
+    dtype = _dtype(input)
+    aw = helper.create_parameter(helper.param_attr, shape=[m + d, 1],
+                                 dtype=dtype)
+    ab = helper.create_parameter(helper.bias_attr, shape=[1], dtype=dtype,
+                                 is_bias=True)
+    asc = helper.create_parameter(None, shape=[1], dtype=dtype)
+    asb = helper.create_parameter(None, shape=[1], dtype=dtype,
+                                  is_bias=True)
+    import copy as _copy
+    pattr = _copy.copy(ParamAttr._to_attr(param_attr))
+    if pattr.name is not None:
+        pattr.name = pattr.name + "_lstm"
+    lw = helper.create_parameter(pattr, shape=[m + d, 4 * d], dtype=dtype)
+    lb = helper.create_parameter(None, shape=[4 * d], dtype=dtype,
+                                 is_bias=True)
+    b_sz, t_sz = input.shape[0], input.shape[1]
+    hidden = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(b_sz, t_sz, d))
+    cell = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(b_sz, t_sz, d))
+    inputs = {"X": input, "AttentionWeight": aw, "AttentionBias": ab,
+              "AttentionScalar": asc, "AttentionScalarBias": asb,
+              "LSTMWeight": lw, "LSTMBias": lb}
+    if lengths is not None:
+        inputs["Lengths"] = lengths
+    if h_0 is not None:
+        inputs["H0"] = h_0
+    if c_0 is not None:
+        inputs["C0"] = c_0
+    helper.append_op("attention_lstm", inputs,
+                     {"Hidden": hidden, "Cell": cell}, {})
+    return hidden, cell
+
+
+def tree_conv(nodes_vector, edge_set, output_size, num_filters=1,
+              max_depth=2, act="tanh", param_attr=None, bias_attr=None,
+              name=None):
+    """Tree-based convolution (ref ``nn.py`` tree_conv /
+    ``tree_conv_op.cc``, TBCNN): continuous-binary-tree filters over
+    subtree patches. Returns [*, N, output_size, num_filters] (batched)
+    like the reference's [N, output_size, num_filters]."""
+    helper = LayerHelper("tree_conv", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    dtype = _dtype(nodes_vector)
+    fdim = int(nodes_vector.shape[-1])
+    w = helper.create_parameter(
+        helper.param_attr, shape=[fdim, 3, output_size, num_filters],
+        dtype=dtype)
+    lead = tuple(nodes_vector.shape[:-1])
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=lead + (output_size, num_filters))
+    helper.append_op("tree_conv",
+                     {"NodesVector": nodes_vector, "EdgeSet": edge_set,
+                      "Filter": w},
+                     {"Out": out}, {"max_depth": max_depth})
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr,
+                                    shape=[num_filters], dtype=dtype,
+                                    is_bias=True)
+        biased = helper.create_variable_for_type_inference(
+            dtype=dtype, shape=out.shape)
+        helper.append_op("elementwise_add", {"X": out, "Y": b},
+                         {"Out": biased}, {"axis": -1})
+        out = biased
+    return helper.append_activation(out)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, use_cudnn=True,
+           ceil_mode=False, exclusive=True, name=None):
+    """3-D pooling over NCDHW input (ref ``nn.py`` pool3d)."""
+    def _t3(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    helper = LayerHelper("pool3d", name=name)
+    k, s, p = _t3(pool_size), _t3(pool_stride), _t3(pool_padding)
+    n, c, d, h, w_ = input.shape
+    if global_pooling:
+        out_shape = (n, c, 1, 1, 1)
+    else:
+        rnd = (lambda a, b: -(-a // b)) if ceil_mode \
+            else (lambda a, b: a // b)
+        dims = [rnd(sp + 2 * pp - kk, st) + 1 if sp > 0 else -1
+                for sp, kk, st, pp in zip((d, h, w_), k, s, p)]
+        out_shape = (n, c) + tuple(dims)
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(input), shape=out_shape)
+    helper.append_op(
+        "pool3d", {"X": input}, {"Out": out},
+        {"pooling_type": pool_type, "ksize": k, "strides": s,
+         "paddings": p, "global_pooling": global_pooling,
+         "ceil_mode": ceil_mode, "exclusive": exclusive})
+    return out
+
+
+def adaptive_pool3d(input, pool_size, pool_type="max", name=None):
+    """Adaptive 3-D pooling to a fixed output size (equal bins)."""
+    helper = LayerHelper("adaptive_pool3d", name=name)
+    k = list(pool_size) if isinstance(pool_size, (list, tuple)) \
+        else [pool_size] * 3
+    n, c = input.shape[0], input.shape[1]
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(input), shape=(n, c) + tuple(k))
+    helper.append_op("pool3d", {"X": input}, {"Out": out},
+                     {"pooling_type": pool_type, "ksize": k,
+                      "strides": k, "paddings": [0, 0, 0],
+                      "adaptive": True})
+    return out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     padding=0, stride=1, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, use_cudnn=True,
+                     act=None, name=None):
+    """3-D transposed convolution over NCDHW (ref ``nn.py``
+    conv3d_transpose / ``conv_transpose_op.cc``)."""
+    def _t3(v):
+        return list(v) if isinstance(v, (list, tuple)) else [v] * 3
+
+    helper = LayerHelper("conv3d_transpose", param_attr=param_attr,
+                         bias_attr=bias_attr, act=act, name=name)
+    s, p, dl = _t3(stride), _t3(padding), _t3(dilation)
+    fs = _t3(filter_size)
+    n, cin, d, h, w_ = input.shape
+    dtype = _dtype(input)
+    w = helper.create_parameter(
+        helper.param_attr,
+        shape=[cin, num_filters // groups] + fs, dtype=dtype)
+    dims = [(sp - 1) * st - 2 * pp + dd * (kk - 1) + 1 if sp > 0 else -1
+            for sp, st, pp, dd, kk in zip((d, h, w_), s, p, dl, fs)]
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=(n, num_filters) + tuple(dims))
+    helper.append_op("conv3d_transpose",
+                     {"Input": input, "Filter": w}, {"Output": out},
+                     {"strides": s, "paddings": p, "dilations": dl,
+                      "groups": groups})
+    pre_act = out
+    if bias_attr is not False:
+        b = helper.create_parameter(helper.bias_attr, shape=[num_filters],
+                                    dtype=dtype, is_bias=True)
+        pre_act = helper.create_variable_for_type_inference(
+            dtype=dtype, shape=out.shape)
+        helper.append_op("elementwise_add", {"X": out, "Y": b},
+                         {"Out": pre_act}, {"axis": 1})
+    return helper.append_activation(pre_act)
+
+
 def lstm(input, init_h=None, init_c=None, max_len=None, hidden_size=None,
          num_layers=1, dropout_prob=0.0, is_bidirec=False, lengths=None,
          is_test=False, name=None, default_initializer=None, seed=-1):
