@@ -171,8 +171,14 @@ def _chunk_marks(tags, valid, scheme, num_types):
         begin = in_chunk & ((prev_t != typ) | (prev_r == 1))
         end = in_chunk & ((role == 1) | (next_t != typ))
     else:  # IOBES: 0=B, 1=I, 2=E, 3=S
-        begin = in_chunk & ((role == 0) | (role == 3) | (prev_t != typ))
-        end = in_chunk & ((role == 2) | (role == 3) | (next_t != typ))
+        # ref ChunkBegin: B/S always begin; I/E begin after an E/S of the
+        # same type (dangling tags start a chunk); any type change begins.
+        begin = in_chunk & ((role == 0) | (role == 3) | (prev_t != typ)
+                            | (prev_r == 2) | (prev_r == 3))
+        # ref ChunkEnd: E/S always end; B/I end before a B/S of the same
+        # type; any type change ends.
+        end = in_chunk & ((role == 2) | (role == 3) | (next_t != typ)
+                          | (next_r == 0) | (next_r == 3))
     return begin, end, typ
 
 

@@ -32,42 +32,86 @@ def test_edit_distance_vs_bruteforce(rng):
                  {"Out": want})
 
 
-def test_chunk_eval_vs_bruteforce(rng):
+def _ref_chunk_segments(seq, scheme, num_types):
+    """Direct port of the reference chunk state machine
+    (``chunk_eval_op.h`` GetSegments/ChunkBegin/ChunkEnd:40-106): a
+    dangling inside/end tag after Other still begins a chunk, etc."""
+    n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    tb, ti, te, ts = {"plain": (-1, -1, -1, -1), "IOB": (0, 1, -1, -1),
+                      "IOE": (-1, 0, 1, -1), "IOBES": (0, 1, 2, 3)}[scheme]
+    other = num_types
+
+    def chunk_end(pt, pty, t, ty):
+        if pty == other:
+            return False
+        if ty == other or ty != pty:
+            return True
+        if pt in (tb, ti):
+            return t in (tb, ts)
+        return pt in (te, ts)
+
+    def chunk_begin(pt, pty, t, ty):
+        if pty == other:
+            return ty != other
+        if ty == other:
+            return False
+        if ty != pty or t in (tb, ts):
+            return True
+        if t in (ti, te):
+            return pt in (te, ts)
+        return False
+
+    segs = []
+    start, in_chunk, tag, typ = 0, False, -1, other
+    for i, v in enumerate(seq):
+        ptag, ptyp = tag, typ
+        tag, typ = int(v) % n_tag, int(v) // n_tag
+        if in_chunk and chunk_end(ptag, ptyp, tag, typ):
+            segs.append((start, i - 1, ptyp))
+            in_chunk = False
+        if chunk_begin(ptag, ptyp, tag, typ):
+            start, in_chunk = i, True
+    if in_chunk:
+        segs.append((start, len(seq) - 1, typ))
+    return segs
+
+
+def _ref_chunk_eval(inf, lbl, lens, scheme, num_types, excluded=()):
+    excluded = set(excluded)
+    n_inf = n_lbl = n_cor = 0
+    for i in range(inf.shape[0]):
+        ci = _ref_chunk_segments(inf[i, :lens[i]], scheme, num_types)
+        cl = _ref_chunk_segments(lbl[i, :lens[i]], scheme, num_types)
+        n_inf += sum(s[2] not in excluded for s in ci)
+        n_lbl += sum(s[2] not in excluded for s in cl)
+        n_cor += sum(s[2] not in excluded for s in set(ci) & set(cl))
+    p = n_cor / n_inf if n_inf else 0.0
+    r = n_cor / n_lbl if n_lbl else 0.0
+    return p, r, n_cor
+
+
+@pytest.mark.parametrize("scheme,excluded", [
+    ("IOB", ()), ("IOE", ()), ("IOBES", ()), ("plain", ()),
+    ("IOB", (1,)), ("IOBES", (0, 2)),
+])
+def test_chunk_eval_vs_bruteforce(rng, scheme, excluded):
     num_types = 3
-    O = num_types * 2
-
-    def chunks(seq, ln):
-        out, i = [], 0
-        while i < ln:
-            if seq[i] % 2 == 0 and seq[i] < O:
-                typ = seq[i] // 2
-                j = i + 1
-                while j < ln and seq[j] == typ * 2 + 1:
-                    j += 1
-                out.append((i, j, typ))
-                i = j
-            else:
-                i += 1
-        return set(out)
-
+    n_tag = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    O = num_types * n_tag
     b, t = 4, 12
     inf = rng.randint(0, O + 1, (b, t)).astype("int64")
     lbl = rng.randint(0, O + 1, (b, t)).astype("int64")
     lens = np.array([12, 9, 5, 12], dtype="int64")
-    n_inf = n_lbl = n_cor = 0
-    for i in range(b):
-        ci = chunks(inf[i], lens[i])
-        cl = chunks(lbl[i], lens[i])
-        n_inf += len(ci)
-        n_lbl += len(cl)
-        n_cor += len(ci & cl)
-    p = n_cor / max(n_inf, 1)
-    r = n_cor / max(n_lbl, 1)
+    p, r, n_cor = _ref_chunk_eval(inf, lbl, lens, scheme, num_types,
+                                  excluded)
+    attrs = {"num_chunk_types": num_types, "chunk_scheme": scheme}
+    if excluded:
+        attrs["excluded_chunk_types"] = list(excluded)
     check_output("chunk_eval",
                  {"Inference": inf, "Label": lbl, "SeqLength": lens},
                  {"Precision": np.float32(p), "Recall": np.float32(r),
                   "NumCorrectChunks": np.int64(n_cor)},
-                 {"num_chunk_types": num_types}, atol=1e-5, rtol=1e-5)
+                 attrs, atol=1e-5, rtol=1e-5)
 
 
 def test_mean_iou(rng):
