@@ -26,12 +26,18 @@ _BINOPS = {
 }
 
 
-def _make_binop(name, fn):
+def _make_binop(name, fn, harmonize=True):
+    # harmonize=False for comparisons: demoting an f32 operand to bf16
+    # would change mask RESULTS at rounding boundaries, and bool outputs
+    # gain no bf16-residency benefit.
     @register(name)
     def _impl(env, op, fn=fn):
         x = get(env, op.input("X"))
         y = get(env, op.input("Y"))
         y = bcast_y(x, y, op.attr("axis", -1))
+        if harmonize:
+            from ..op_registry import amp_harmonize
+            x, y = amp_harmonize(x, y)
         put(env, op.output("Out"), fn(x, y))
 
 
@@ -48,7 +54,7 @@ _CMPOPS = {
 }
 
 for _n, _f in _CMPOPS.items():
-    _make_binop(_n, _f)
+    _make_binop(_n, _f, harmonize=False)
 
 
 @register("logical_and")

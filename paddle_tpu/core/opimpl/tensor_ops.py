@@ -296,7 +296,8 @@ def _lookup_table(env, op):
     if padding_idx is not None and padding_idx >= 0:
         mask = (ids != padding_idx)[..., None]
         out = out * mask.astype(out.dtype)
-    put(env, op.output("Out"), out)
+    from ..op_registry import amp_out_cast
+    put(env, op.output("Out"), amp_out_cast(out))
 
 
 @register("one_hot")

@@ -241,6 +241,65 @@ _fused.defvjp(_fused_fwd, _fused_bwd)
 # public entry
 # ---------------------------------------------------------------------------
 
+# ---------------------------------------------------------------------------
+# bf16-resident materialized path: logits stored ONCE in bf16 (half the
+# plain-f32 HBM traffic), statistics and the softmax in f32 streamed from
+# the bf16 tensor, and a custom vjp that hands the backward dots a bf16
+# dlogits (XLA's autodiff of the f32 composition would materialize a 4 GB
+# f32 dlogits).  Engages under AMP when the Pallas-fused path doesn't.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def _bf16_ce(x2, w, b, y2, eps):
+    loss, _ = _bf16_ce_fwd(x2, w, b, y2, eps)
+    return loss
+
+
+def _bf16_stats(logits, y2, eps):
+    v = logits.shape[-1]
+    lf = logits.astype(jnp.float32)          # fused into the streaming pass
+    m = jnp.max(lf, axis=-1)
+    s = jnp.sum(jnp.exp(lf - m[:, None]), axis=-1)
+    lse = m + jnp.log(s)
+    logit_y = jnp.take_along_axis(logits, y2[:, None],
+                                  axis=-1)[:, 0].astype(jnp.float32)
+    loss = lse - (1.0 - eps) * logit_y
+    if eps:
+        loss = loss - eps * jnp.sum(lf, axis=-1) / v
+    return loss, m, s
+
+
+def _bf16_ce_fwd(x2, w, b, y2, eps):
+    xb = x2.astype(jnp.bfloat16)
+    wb = w.astype(jnp.bfloat16)
+    logits = jnp.dot(xb, wb)                 # bf16-stored [T, V]
+    if b is not None:
+        logits = logits + b.astype(jnp.bfloat16)
+    loss, m, s = _bf16_stats(logits, y2, eps)
+    return loss, (xb, wb, logits, m, s, y2)
+
+
+def _bf16_ce_bwd(eps, res, g):
+    xb, wb, logits, m, s, y2 = res
+    t, v = logits.shape
+    p = jnp.exp(logits.astype(jnp.float32) - m[:, None]) / s[:, None]
+    onehot = (jax.lax.broadcasted_iota(jnp.int32, (t, v), 1)
+              == y2[:, None])
+    dz = p - (1.0 - eps) * onehot.astype(jnp.float32)
+    if eps:
+        dz = dz - eps / v
+    dl = (dz * g[:, None].astype(jnp.float32)).astype(jnp.bfloat16)
+    # bf16 OPERANDS (the traffic win) with f32-stored dot outputs: the MXU
+    # accumulates f32 regardless, storing bf16 would just re-round grads
+    dx = jnp.dot(dl, wb.T, preferred_element_type=jnp.float32)
+    dw = jnp.dot(xb.T, dl, preferred_element_type=jnp.float32)
+    db = jnp.sum(dl.astype(jnp.float32), axis=0)
+    return dx, dw, db, None
+
+
+_bf16_ce.defvjp(_bf16_ce_fwd, _bf16_ce_bwd)
+
+
 def linear_smooth_ce(x, w, b, y, eps):
     """x: [..., D] activations; w: [D, V]; b: [V] or None; y: [...] int
     labels. Returns per-position f32 loss of shape ``x.shape[:-1]``."""
@@ -252,6 +311,12 @@ def linear_smooth_ce(x, w, b, y, eps):
     if _use_fused(x, w):
         loss = _fused(x2, w, b, y2, float(eps))
         return loss.reshape(lead)
+
+    from ..core.op_registry import amp_enabled, env_flag, single_tpu
+    if (amp_enabled() and single_tpu()
+            and not env_flag("PADDLE_TPU_AMP_F32_ACTS")
+            and not env_flag("PADDLE_TPU_NO_BF16_CE")):  # A/B escape hatch
+        return _bf16_ce(x2, w, b, y2, float(eps)).reshape(lead)
 
     # reference path (CPU / mesh): plain projection + closed-form smooth CE
     logits = jnp.dot(x2, w, preferred_element_type=jnp.float32)

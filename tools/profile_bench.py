@@ -92,7 +92,9 @@ def analyze(trace_dir, steps, topk=40):
     for (pn, ln), tot in sorted(by_line.items(), key=lambda kv: -kv[1]):
         print("  %-60s %8.4f" % (pn + " :: " + ln, tot))
 
-    oprows = [r for r in rows if "XLA Ops" in r[1]]
+    # EXACT line match: "Async XLA Ops" carries overlapped copy/slice
+    # starts whose durations double-count against the sync op stream.
+    oprows = [r for r in rows if r[1] == "XLA Ops"]
     if not oprows:
         oprows = rows
     agg = defaultdict(lambda: [0.0, 0])
@@ -110,6 +112,37 @@ def analyze(trace_dir, steps, topk=40):
         out.append({"name": name, "ms": tot * 1e3, "pct": pct, "count": cnt})
     with open(os.path.join(trace_dir, "summary.json"), "w") as f:
         json.dump(out, f, indent=1)
+
+    # category roll-up: the ms-by-ms budget table
+    cat = defaultdict(float)
+    for pn, ln, name, dur, stats in oprows:
+        cat[_categorize(name)] += dur
+    print("\n== category budget (ms/step) ==")
+    for c, tot in sorted(cat.items(), key=lambda kv: -kv[1]):
+        print("  %8.3f ms  %5.1f%%  %s"
+              % (tot / steps * 1e3, 100.0 * tot / max(total, 1e-12), c))
+
+
+def _categorize(name):
+    """Bucket an HLO op name into a budget category."""
+    n = name.lower()
+    if "custom-call" in n or "tpu_custom_call" in n or "pallas" in n:
+        return "pallas-custom-call"
+    if n.startswith("%copy") or "copy-start" in n or "copy-done" in n:
+        return "copies"
+    if "slice-start" in n or "slice-done" in n or "async" in n:
+        return "async-slices"
+    if "convolution" in n or n.startswith("%dot") or "dot_general" in n:
+        return "matmul"
+    if "rng" in n or "bitcast-convert" in n and "threefry" in n:
+        return "rng"
+    if "all-reduce" in n or "all-gather" in n or "collective" in n:
+        return "collectives"
+    if "reduce" in n:
+        return "reduce"
+    if "fusion" in n:
+        return "fusion-other"
+    return "other"
 
 
 if __name__ == "__main__":
