@@ -41,8 +41,12 @@ def _make_binop(name, fn, harmonize=True):
         put(env, op.output("Out"), fn(x, y))
 
 
+# mod/floordiv produce discrete outputs that can flip at bf16 rounding
+# boundaries (same rationale as comparisons) — keep them out of harmonize
 for _n, _f in _BINOPS.items():
-    _make_binop(_n, _f)
+    _make_binop(_n, _f,
+                harmonize=_n not in ("elementwise_mod",
+                                     "elementwise_floordiv"))
 
 _CMPOPS = {
     "less_than": jnp.less,

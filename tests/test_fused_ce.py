@@ -101,27 +101,30 @@ def test_layer_end_to_end():
     assert float(last) < 0.5 * float(first)
 
 
-def test_bf16_materialized_path_parity():
+@pytest.mark.parametrize("with_bias", [False, True])
+def test_bf16_materialized_path_parity(with_bias):
     """The AMP bf16-logits custom-vjp path (engaged on single-TPU AMP when
     the Pallas kernel doesn't) matches the f32 reference within bf16
-    tolerance, forward and grads."""
+    tolerance, forward and grads (incl. the bias add + db cotangent)."""
     rng = np.random.RandomState(3)
     t, d, v = 64, 32, 101
     x = jnp.asarray(rng.randn(t, d), jnp.float32)
     w = jnp.asarray(rng.randn(d, v) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.randn(v) * 0.1, jnp.float32) if with_bias else None
     y = jnp.asarray(rng.randint(0, v, (t,)), jnp.int32)
+    args = (x, w) + ((b,) if with_bias else ())
+    argnums = tuple(range(len(args)))
 
-    def f_bf16(x, w):
-        return fc._bf16_ce(x, w, None, y, 0.1).sum()
+    def f_bf16(x, w, *rest):
+        return fc._bf16_ce(x, w, rest[0] if rest else None, y, 0.1).sum()
 
-    def f_ref(x, w):
-        return _ref(x, w, None, y, 0.1).sum()
+    def f_ref(x, w, *rest):
+        return _ref(x, w, rest[0] if rest else None, y, 0.1).sum()
 
-    l1, (dx1, dw1) = jax.value_and_grad(f_bf16, argnums=(0, 1))(x, w)
-    l2, (dx2, dw2) = jax.value_and_grad(f_ref, argnums=(0, 1))(x, w)
+    l1, g1 = jax.value_and_grad(f_bf16, argnums=argnums)(*args)
+    l2, g2 = jax.value_and_grad(f_ref, argnums=argnums)(*args)
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2),
                                rtol=2e-2, atol=2e-2 * t)
-    np.testing.assert_allclose(np.asarray(dx1), np.asarray(dx2),
-                               rtol=1e-1, atol=3e-2)
-    np.testing.assert_allclose(np.asarray(dw1), np.asarray(dw2),
-                               rtol=1e-1, atol=3e-2)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-1, atol=3e-2)

@@ -14,36 +14,23 @@ Usage:
 """
 
 import argparse
-import glob
 import os
 import re
 import sys
 from collections import defaultdict
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from profile_bench import parse_xplane  # shared xplane walk
 
 
 def profile_self_times(trace_dir):
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-    space = xplane_pb2.XSpace()
-    path = sorted(glob.glob(os.path.join(
-        trace_dir, "plugins/profile/*/*.xplane.pb")))[-1]
-    with open(path, "rb") as f:
-        space.ParseFromString(f.read())
     agg = defaultdict(float)
-    for plane in space.planes:
-        if "TPU" not in plane.name:
+    for pn, ln, name, dur in parse_xplane(trace_dir):
+        if ln != "XLA Ops":  # exact: skip the overlapped async line
             continue
-        emeta = plane.event_metadata
-        for line in plane.lines:
-            if line.name != "XLA Ops":  # exact: skip overlapped async line
-                continue
-            for ev in line.events:
-                md = emeta.get(ev.metadata_id)
-                name = md.name if md else str(ev.metadata_id)
-                # bare instruction name: "%foo.12 = ..." -> "foo.12"
-                bare = name.split(" =")[0].lstrip("%")
-                agg[bare] += ev.duration_ps / 1e12
+        # bare instruction name: "%foo.12 = ..." -> "foo.12"
+        agg[name.split(" =")[0].lstrip("%")] += dur
     return agg
 
 

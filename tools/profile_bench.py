@@ -52,8 +52,10 @@ def capture(model, steps, batch=None):
     return trace_dir
 
 
-def analyze(trace_dir, steps, topk=40):
-    """Parse the xplane proto; aggregate device-op self time."""
+def parse_xplane(trace_dir):
+    """Parse the newest xplane proto under ``trace_dir`` into
+    (plane_name, line_name, op_name, seconds) rows. Shared by
+    ``tools/attribute_transformer.py``."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = sorted(glob.glob(os.path.join(
@@ -69,20 +71,19 @@ def analyze(trace_dir, steps, topk=40):
         if "TPU" not in plane.name and "/device" not in plane.name.lower():
             continue
         emeta = plane.event_metadata
-        smeta = plane.stat_metadata
         for line in plane.lines:
             for ev in line.events:
                 md = emeta.get(ev.metadata_id)
                 name = md.name if md else str(ev.metadata_id)
-                dur = ev.duration_ps / 1e12
-                stats = {}
-                for st in ev.stats:
-                    sm = smeta.get(st.metadata_id)
-                    if sm:
-                        v = (st.str_value or st.int64_value or
-                             st.uint64_value or st.double_value)
-                        stats[sm.name] = v
-                rows.append((plane.name, line.name, name, dur, stats))
+                rows.append((plane.name, line.name, name,
+                             ev.duration_ps / 1e12))
+    return rows
+
+
+def analyze(trace_dir, steps, topk=40):
+    """Aggregate device-op self time from an xplane trace."""
+    rows = [(pn, ln, name, dur, {})
+            for pn, ln, name, dur in parse_xplane(trace_dir)]
 
     # Aggregate by op name on op-level lines
     by_line = defaultdict(float)
