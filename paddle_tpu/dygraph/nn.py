@@ -43,6 +43,8 @@ class FC(Layer):
         act, size, nfd = self._act, self._size, self._num_flatten_dims
 
         def fn(xv, w, b):
+            if xv.dtype == jnp.bfloat16:  # compute follows activation
+                w, b = w.astype(xv.dtype), b.astype(xv.dtype)
             xv2 = xv.reshape(int(np.prod(xv.shape[:nfd])), -1)
             out = (xv2 @ w + b).reshape(tuple(xv.shape[:nfd]) + (size,))
             if act:
@@ -191,10 +193,14 @@ class LayerNorm(Layer):
         nshape, eps = len(self._shape), self._eps
 
         def fn(xv, scale, bias):
+            in_dtype = xv.dtype
+            if in_dtype == jnp.bfloat16:  # f32 stats, bf16-resident out
+                xv = xv.astype(jnp.float32)
             axes = tuple(range(xv.ndim - nshape, xv.ndim))
             mu = jnp.mean(xv, axis=axes, keepdims=True)
             var = jnp.var(xv, axis=axes, keepdims=True)
-            return (xv - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+            out = (xv - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+            return out.astype(in_dtype)
 
         return record(fn, x, self._scale, self._bias)
 

@@ -313,8 +313,11 @@ def linear_smooth_ce(x, w, b, y, eps):
         return loss.reshape(lead)
 
     from ..core.op_registry import amp_enabled, env_flag, single_tpu
-    if (amp_enabled() and single_tpu()
-            and not env_flag("PADDLE_TPU_AMP_F32_ACTS")
+    # engage on op-registry AMP, or when the caller already runs bf16
+    # activations (the dygraph build's per-layer casts)
+    wants_bf16 = (amp_enabled() and not env_flag("PADDLE_TPU_AMP_F32_ACTS")
+                  ) or x.dtype == jnp.bfloat16
+    if (wants_bf16 and single_tpu()
             and not env_flag("PADDLE_TPU_NO_BF16_CE")):  # A/B escape hatch
         return _bf16_ce(x2, w, b, y2, float(eps)).reshape(lead)
 

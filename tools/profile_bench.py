@@ -82,12 +82,11 @@ def parse_xplane(trace_dir):
 
 def analyze(trace_dir, steps, topk=40):
     """Aggregate device-op self time from an xplane trace."""
-    rows = [(pn, ln, name, dur, {})
-            for pn, ln, name, dur in parse_xplane(trace_dir)]
+    rows = parse_xplane(trace_dir)
 
     # Aggregate by op name on op-level lines
     by_line = defaultdict(float)
-    for pn, ln, name, dur, stats in rows:
+    for pn, ln, name, dur in rows:
         by_line[(pn, ln)] += dur
     print("== device lines (total s over %d steps) ==" % steps)
     for (pn, ln), tot in sorted(by_line.items(), key=lambda kv: -kv[1]):
@@ -99,7 +98,7 @@ def analyze(trace_dir, steps, topk=40):
     if not oprows:
         oprows = rows
     agg = defaultdict(lambda: [0.0, 0])
-    for pn, ln, name, dur, stats in oprows:
+    for pn, ln, name, dur in oprows:
         agg[name][0] += dur
         agg[name][1] += 1
     total = sum(v[0] for v in agg.values())
@@ -116,7 +115,7 @@ def analyze(trace_dir, steps, topk=40):
 
     # category roll-up: the ms-by-ms budget table
     cat = defaultdict(float)
-    for pn, ln, name, dur, stats in oprows:
+    for pn, ln, name, dur in oprows:
         cat[_categorize(name)] += dur
     print("\n== category budget (ms/step) ==")
     for c, tot in sorted(cat.items(), key=lambda kv: -kv[1]):
