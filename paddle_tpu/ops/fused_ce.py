@@ -314,9 +314,11 @@ def linear_smooth_ce(x, w, b, y, eps):
 
     from ..core.op_registry import amp_enabled, env_flag, single_tpu
     # engage on op-registry AMP, or when the caller already runs bf16
-    # activations (the dygraph build's per-layer casts)
-    wants_bf16 = (amp_enabled() and not env_flag("PADDLE_TPU_AMP_F32_ACTS")
-                  ) or x.dtype == jnp.bfloat16
+    # activations (the dygraph build's per-layer casts); the F32_ACTS
+    # escape hatch disables it in BOTH cases (mxu_cast hands this op a
+    # bf16 x under static AMP regardless of that flag)
+    wants_bf16 = ((amp_enabled() or x.dtype == jnp.bfloat16)
+                  and not env_flag("PADDLE_TPU_AMP_F32_ACTS"))
     if (wants_bf16 and single_tpu()
             and not env_flag("PADDLE_TPU_NO_BF16_CE")):  # A/B escape hatch
         return _bf16_ce(x2, w, b, y2, float(eps)).reshape(lead)

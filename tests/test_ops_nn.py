@@ -142,3 +142,23 @@ def test_smooth_softmax_ce_grad(rng):
         return fluid.layers.reduce_sum(loss)
 
     check_grad(build, {"x": logits_np, "y": label_np}, ["x"])
+
+
+def test_batch_norm_train_large_mean(rng):
+    """Training-mode BN with offset inputs (e.g. raw pixel ranges):
+    one-pass f32 moments must stay accurate to mean/std ratios of ~1e2.
+    (Beyond ~1e3 the E[x^2]-E[x]^2 form degrades — the same bound as the
+    reference's cuDNN CUDNN_BATCHNORM_SPATIAL single-pass moments.)"""
+    import paddle_tpu as fluid
+
+    x = (rng.randn(8, 3, 8, 8) * 1.0 + 128.0).astype("float32")
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        xv = fluid.layers.data("x", shape=[3, 8, 8])
+        y = fluid.layers.batch_norm(xv)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        out, = exe.run(main, feed={"x": x}, fetch_list=[y])
+    want = (x - x.mean(axis=(0, 2, 3), keepdims=True)) / np.sqrt(
+        x.var(axis=(0, 2, 3), keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, want, rtol=5e-2, atol=5e-2)
