@@ -19,10 +19,16 @@ import numpy as np
 
 
 def _peak_flops(device):
-    """Peak bf16 matmul FLOPs/s for the benched chip (fallback 1e14)."""
+    """Peak bf16 matmul FLOPs/s for the benched chip (fallback 1e14).
+
+    v5e is 197 TFLOPs bf16 (394 is its INT8 TOPS figure — rounds 1-3
+    mistakenly used the int8 number as the bf16 peak, understating MFU
+    by 2x; see NOTES_r4.md. The sibling entries v4/v5p/v6e were always
+    the correct bf16 peaks, and the measured chip ceiling is 175.8 TF/s
+    = 89% of 197, a normal achievable fraction — tools/chip_ceiling.py)."""
     kind = getattr(device, "device_kind", "").lower()
     table = {
-        "v5e": 394e12, "v5litepod": 394e12, "v5 lite": 394e12,
+        "v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,
         "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12,
         "v4": 275e12, "v3": 123e12, "v2": 45e12,
     }
@@ -31,7 +37,7 @@ def _peak_flops(device):
             return v
     if device.platform == "cpu":
         return 1e11  # nominal, for smoke runs
-    return 394e12  # assume v5e-class if unrecognized
+    return 197e12  # assume v5e-class if unrecognized
 
 
 def _build(model, on_tpu):

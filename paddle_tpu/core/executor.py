@@ -384,6 +384,11 @@ class Executor:
 
         state = {n: scope.get(n) for n in state_in_names}
         rng = scope.get(RNG_KEY)
+        # abstract snapshot for lowered_hlo_text (state buffers are
+        # donated below, so keep avals, not arrays)
+        self._last_call = (jfn, jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") else a, (state, feed_arrays, rng)))
         fetches, new_state, rng_out = jfn(state, feed_arrays, rng)
         scope.set(RNG_KEY, rng_out)
         for n, v in new_state.items():
@@ -392,10 +397,22 @@ class Executor:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
 
+    def lowered_hlo_text(self):
+        """Optimized HLO text of the step this executor LAST ran —
+        the compiled-module inspection surface for multi-chip sharding
+        assertions (``parallel/sharding_check.py``; ref analog:
+        ``multi_devices_graph_check_pass.cc`` asserting SSA-graph
+        structure). Re-lowers from cached avals; call after ``run``."""
+        if not getattr(self, "_last_call", None):
+            raise RuntimeError("no prior run() to inspect")
+        jfn, (state, feed_arrays, rng) = self._last_call
+        return jfn.lower(state, feed_arrays, rng).compile().as_text()
+
     def close(self):
         """Parity with ``Executor::Close`` (``executor.cc:139``): release the
         compiled-program cache."""
         self._cache.clear()
+        self._last_call = None
 
     # -- debug run-mode -----------------------------------------------------
     def _run_checked(self, program, feed, fetch_list, scope, return_numpy):

@@ -161,25 +161,32 @@ class Optimizer:
 
             new_pg = []
             for p, g in params_grads:
-                if getattr(g, "sparse_rows_var", None) is not None:
-                    raise NotImplementedError(
-                        "accumulate_steps with sparse (is_sparse=True) "
-                        "gradients is not supported; use dense embedding "
-                        "gradients when accumulating")
+                rows = getattr(g, "sparse_rows_var", None)
+                # accumulator is always DENSE [p.shape]: sparse micro-step
+                # grads scatter-add into it (ref multi_batch_merge_pass.cc
+                # likewise materializes merged grads); apply steps then
+                # take the dense optimizer branch. Out-of-range sentinel
+                # rows (the sparse path's duplicate parking) drop in the
+                # scatter.
                 acc = block.create_var(
                     name=unique_name.generate("%s@GRAD_ACC" % p.name),
-                    shape=g.shape, dtype=str(g.dtype), persistable=True)
+                    shape=p.shape, dtype=str(g.dtype), persistable=True)
                 sb = framework.default_startup_program().global_block()
-                sp = sb.create_var(name=acc.name, shape=g.shape,
+                sp = sb.create_var(name=acc.name, shape=p.shape,
                                    dtype=str(g.dtype), persistable=True)
                 sb.append_op("fill_constant", outputs={"Out": sp},
-                             attrs={"shape": tuple(g.shape),
+                             attrs={"shape": tuple(p.shape),
                                     "dtype": str(g.dtype), "value": 0.0})
                 acc_sum = block.create_var(
                     name=unique_name.generate("%s@GRAD_ACC_SUM" % p.name),
-                    shape=g.shape, dtype=str(g.dtype))
-                block.append_op("elementwise_add", {"X": acc, "Y": g},
-                                {"Out": acc_sum}, {})
+                    shape=p.shape, dtype=str(g.dtype))
+                if rows is not None:
+                    block.append_op("scatter",
+                                    {"X": acc, "Ids": rows, "Updates": g},
+                                    {"Out": acc_sum}, {"overwrite": False})
+                else:
+                    block.append_op("elementwise_add", {"X": acc, "Y": g},
+                                    {"Out": acc_sum}, {})
                 avg = lnn.scale(acc_sum, scale=1.0 / k)
                 # write-back: keep the sum between apply steps, reset after
                 block.append_op("elementwise_mul",

@@ -103,12 +103,58 @@ def test_accumulation_trains():
     assert float(l) < 0.2 * first
 
 
-def test_sparse_grads_rejected():
-    main, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main, startup):
-        ids = layers.data("ids", shape=[4], dtype="int64")
-        emb = layers.embedding(ids, size=(50, 8), is_sparse=True)
-        loss = layers.mean(emb)
-        with pytest.raises(NotImplementedError):
-            fluid.optimizer.SGD(learning_rate=0.1).minimize(
-                loss, accumulate_steps=2)
+def test_sparse_grad_accumulation_parity():
+    """Sparse (is_sparse=True) embedding grads accumulate through the
+    dense scatter-add accumulator: k micro-steps == one k*b step
+    (VERDICT r3 ask #8; ref multi_batch_merge_pass.cc composes with
+    sparse grads)."""
+    from paddle_tpu.core import unique_name
+
+    k, b, vocab, dim = 3, 6, 20, 8
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, vocab, (k * b, 1)).astype(np.int64)
+    Y = rng.randn(k * b, 1).astype(np.float32)
+
+    def build(acc_steps):
+        old_gen = unique_name.switch()
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 7
+        with fluid.program_guard(main, startup):
+            iv = layers.data("ids", shape=[1], dtype="int64")
+            y = layers.data("y", shape=[1], dtype="float32")
+            emb = layers.embedding(iv, size=[vocab, dim], is_sparse=True)
+            pred = layers.fc(emb, size=1)
+            loss = layers.mean(layers.square_error_cost(pred, y))
+            fluid.optimizer.SGD(learning_rate=0.5).minimize(
+                loss, accumulate_steps=acc_steps)
+        unique_name.switch(old_gen)
+        return main, startup, loss
+
+    exe = fluid.Executor(fluid.CPUPlace())
+
+    main_a, startup_a, loss_a = build(k)
+    scope_a = fluid.Scope()
+    with fluid.scope_guard(scope_a):
+        exe.run(startup_a)
+        before = _params(scope_a, main_a)
+        for i in range(k - 1):
+            exe.run(main_a, feed={"ids": ids[i * b:(i + 1) * b],
+                                  "y": Y[i * b:(i + 1) * b]},
+                    fetch_list=[loss_a])
+            frozen = _params(scope_a, main_a)
+            for n in before:
+                np.testing.assert_array_equal(before[n], frozen[n])
+        exe.run(main_a, feed={"ids": ids[(k - 1) * b:],
+                              "y": Y[(k - 1) * b:]}, fetch_list=[loss_a])
+        after_acc = _params(scope_a, main_a)
+
+    main_b, startup_b, loss_b = build(None)
+    scope_b = fluid.Scope()
+    with fluid.scope_guard(scope_b):
+        exe.run(startup_b)
+        exe.run(main_b, feed={"ids": ids, "y": Y}, fetch_list=[loss_b])
+        after_big = _params(scope_b, main_b)
+
+    for n in after_big:
+        np.testing.assert_allclose(after_acc[n], after_big[n],
+                                   rtol=2e-5, atol=2e-6)

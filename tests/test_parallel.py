@@ -366,3 +366,46 @@ def test_pipeline_crossing_sets_reaching_defs():
               [Op(["a", "b"], ["l"])]]
     cross = _crossing_sets(stages)
     assert cross == [["a"], ["a", "b"]]
+
+
+def test_compiled_hlo_sharding_quality():
+    """VERDICT r3 ask #7: the lowered mesh step's HLO must show (a) no
+    full-parameter all-gather in a plain-dp steady state and (b) actually
+    sharded mp-annotated params; negative controls prove the checks can
+    fail."""
+    import pytest
+    from paddle_tpu import models
+    from paddle_tpu.parallel import sharding_check
+
+    mesh = _mesh((2, 2, 2), ("dp", "mp", "sp"))
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        spec = models.transformer.transformer_base(
+            src_vocab=64, trg_vocab=64, seq_len=32, d_model=64, d_ff=128,
+            n_head=4, n_layer=1, dropout_rate=0.0)
+        fluid.optimizer.Adam(learning_rate=1e-4).minimize(spec.loss)
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        cp = fluid.CompiledProgram(main).with_data_parallel(
+            loss_name=spec.loss.name, mesh=mesh, dp_axis="dp",
+            sp_axis="sp")
+        feed = spec.sample_batch(4, np.random.RandomState(0))
+        lv, = exe.run(cp, feed=feed, fetch_list=[spec.loss])
+        hlo = exe.lowered_hlo_text()
+    assert np.isfinite(lv).all()
+
+    pshapes = [tuple(p.shape) for p in main.global_block().all_parameters()]
+    sharding_check.assert_no_param_allgather(hlo, pshapes)
+    sharding_check.assert_param_sharded(hlo, "enc0_ffn_fc1.w", (64, 128))
+
+    # negative controls: a replicated var must FAIL the sharded check;
+    # an activation all-gather shape posed as a "param" must FAIL (a)
+    with pytest.raises(AssertionError):
+        sharding_check.assert_param_sharded(hlo, "src_word_emb")
+    ag = [s for s in sharding_check.collect_allgather_shapes(hlo)
+          if len(s) >= 2]  # 1-D shapes are filtered by the check itself
+    assert ag, "expected >=2-D activation all-gathers under mp/sp sharding"
+    with pytest.raises(AssertionError):
+        sharding_check.assert_no_param_allgather(hlo, [ag[0]])

@@ -1,0 +1,109 @@
+"""Measure the bench chip's REAL ceilings (matmul TF/s, HBM GB/s) and
+emit one JSON line, so every round's vs_baseline can be read against the
+same measured roofline (VERDICT r3 ask #9; the r3 numbers lived only in
+NOTES prose).
+
+Method: chained on-device loops inside one jit; sync via np.asarray of an
+f32 scalar (``jax.block_until_ready`` does NOT block on the axon
+platform, and pulling large bf16 arrays through the tunnel dominates any
+timing). Per-iteration time is the slope between a short and a long
+chain, which cancels dispatch latency (~80 ms through the tunnel).
+
+Usage: python tools/chip_ceiling.py [--out CHIP_CEILING.json]
+"""
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _slope(make_loop, args, n_lo=2, n_hi=12, tries=5):
+    import jax
+
+    f_lo, f_hi = jax.jit(make_loop(n_lo)), jax.jit(make_loop(n_hi))
+    np.asarray(f_lo(*args))
+    np.asarray(f_hi(*args))
+
+    def wall(f):
+        best = 1e9
+        for _ in range(tries):
+            t0 = time.perf_counter()
+            np.asarray(f(*args))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    return (wall(f_hi) - wall(f_lo)) / (n_hi - n_lo)
+
+
+def matmul_ceiling(dtype, n=8192):
+    """Chained n^3 matmuls; returns sustained FLOPs/s."""
+    import jax
+    import jax.numpy as jnp
+
+    a = jnp.asarray(np.random.randn(n, n) * 0.01, dtype)
+    b = jnp.asarray(np.random.randn(n, n) * 0.01, dtype)
+
+    def make_loop(iters):
+        def run(a, b):
+            def body(i, x):
+                return jax.lax.dot(x, b).astype(dtype) * jnp.asarray(
+                    0.999, dtype)
+            out = jax.lax.fori_loop(0, iters, body, a)
+            return jnp.sum(out.astype(jnp.float32))
+        return run
+
+    dt = _slope(make_loop, (a, b))
+    return 2.0 * n * n * n / dt
+
+
+def hbm_ceiling(mbytes=512):
+    """Chained elementwise passes over a large f32 array; returns
+    sustained read+write bytes/s."""
+    import jax
+    import jax.numpy as jnp
+
+    n = mbytes * 1024 * 1024 // 4
+    x = jnp.ones((n,), jnp.float32)
+
+    def make_loop(iters):
+        def run(x):
+            def body(i, v):
+                return v * 1.0000001 + 1e-9
+            out = jax.lax.fori_loop(0, iters, body, x)
+            return out[0]
+        return run
+
+    dt = _slope(make_loop, (x,))
+    return 2.0 * n * 4 / dt  # one read + one write per pass
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="CHIP_CEILING.json")
+    args = ap.parse_args()
+
+    import jax
+
+    dev = jax.devices()[0]
+    result = {
+        "device_kind": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+        "bf16_matmul_tflops": round(
+            matmul_ceiling(jax.numpy.bfloat16) / 1e12, 1),
+        "int8_matmul_tops": None,  # dot(int8) unsupported via this path
+        "hbm_stream_gbs": round(hbm_ceiling() / 1e9, 1),
+        "nominal_bf16_tflops": 197.0,  # v5e bf16 peak (394 is int8 TOPS)
+        "nominal_hbm_gbs": 819.0,
+    }
+    result["fraction_of_nominal_matmul"] = round(
+        result["bf16_matmul_tflops"] / result["nominal_bf16_tflops"], 3)
+    line = json.dumps(result)
+    print(line)
+    with open(args.out, "w") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
