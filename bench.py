@@ -24,8 +24,8 @@ def _peak_flops(device):
     v5e is 197 TFLOPs bf16 (394 is its INT8 TOPS figure — rounds 1-3
     mistakenly used the int8 number as the bf16 peak, understating MFU
     by 2x; see NOTES_r4.md. The sibling entries v4/v5p/v6e were always
-    the correct bf16 peaks, and the measured chip ceiling is 175.8 TF/s
-    = 89% of 197, a normal achievable fraction — tools/chip_ceiling.py)."""
+    the correct bf16 peaks, and the measured chip ceiling is 175-185 TF/s
+    = ~90% of 197, a normal achievable fraction — tools/chip_ceiling.py)."""
     kind = getattr(device, "device_kind", "").lower()
     table = {
         "v5e": 197e12, "v5litepod": 197e12, "v5 lite": 197e12,

@@ -1,0 +1,78 @@
+"""Automatic mixed precision — bf16 MXU compute with fp32 master state.
+
+Reference capability: ``paddle/contrib/float16/float16_transpiler.py`` (a
+program rewrite to fp16 kernels) and the fp16 type plumbing
+(``platform/float16.h``). The TPU-native design needs no program rewrite and
+no loss scaling: parameters, activations between ops, and optimizer state
+stay float32; matmul/conv/attention operands are cast to bfloat16 at the MXU
+boundary with float32 accumulation (bf16 shares fp32's exponent range, so
+fp16-style loss scaling is unnecessary — ``LossScaler`` is provided for API
+parity and for users that opt into true fp16 feeds).
+
+Usage::
+
+    opt = fluid.optimizer.Adam(1e-4)
+    opt = fluid.amp.decorate(opt)          # bf16 compute on minimize()
+    # or, program-level:
+    fluid.amp.enable_bf16(main_program)
+"""
+
+from .core import framework
+
+__all__ = ["enable_bf16", "disable_bf16", "decorate", "LossScaler"]
+
+
+def enable_bf16(program=None):
+    """Mark a program for bf16 mixed-precision execution."""
+    program = program or framework.default_main_program()
+    program._amp_bf16 = True
+    program._version += 1  # invalidate executor cache entries
+    return program
+
+
+def disable_bf16(program=None):
+    program = program or framework.default_main_program()
+    program._amp_bf16 = False
+    program._version += 1
+    return program
+
+
+class LossScaler:
+    """Static/dynamic loss scaling state (API parity with fp16 trainers;
+    a no-op under bf16 where the exponent range makes it unnecessary)."""
+
+    def __init__(self, init_loss_scaling=1.0, use_dynamic_loss_scaling=False,
+                 incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 incr_ratio=2.0, decr_ratio=0.5):
+        self.loss_scaling = init_loss_scaling
+        self.use_dynamic = use_dynamic_loss_scaling
+        self.incr_every_n_steps = incr_every_n_steps
+        self.decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self.incr_ratio = incr_ratio
+        self.decr_ratio = decr_ratio
+
+
+class _DecoratedOptimizer:
+    def __init__(self, optimizer, scaler):
+        self._opt = optimizer
+        self._scaler = scaler
+
+    def __getattr__(self, name):
+        return getattr(self._opt, name)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, accumulate_steps=None):
+        enable_bf16(loss.block.program)
+        return self._opt.minimize(loss, startup_program=startup_program,
+                                  parameter_list=parameter_list,
+                                  no_grad_set=no_grad_set,
+                                  accumulate_steps=accumulate_steps)
+
+
+def decorate(optimizer, init_loss_scaling=1.0,
+             use_dynamic_loss_scaling=False, **scaler_kwargs):
+    """Wrap an optimizer so ``minimize`` enables bf16 compute on the loss's
+    program (ref contrib mixed-precision ``decorate``)."""
+    scaler = LossScaler(init_loss_scaling, use_dynamic_loss_scaling,
+                        **scaler_kwargs)
+    return _DecoratedOptimizer(optimizer, scaler)

@@ -5,7 +5,7 @@ each own a DataFeed over a file split and run the program op-by-op;
 ``python/paddle/fluid/async_executor.py`` is the Python driver.
 
 TPU-native re-design: the parallelism moves to the right places for one
-big accelerator — C++ reader threads (``paddle_tpu/native_src/prefetch_queue.cc``) keep an
+big accelerator — C++ reader threads (``native/prefetch_queue.cc``) keep an
 MPMC byte-record queue full from recordio files, the host assembles dense
 batches (one np.frombuffer per slot, ``data/data_feed.py``), and ONE
 compiled step function consumes them back-to-back (dispatch is async, so

@@ -1,0 +1,141 @@
+"""Gradient clipping (ref ``python/paddle/fluid/clip.py``:
+GradientClipByValue/Norm/GlobalNorm, set_gradient_clip,
+append_gradient_clip_ops, ErrorClipByValue)."""
+
+from .core import framework
+from .core.framework import Parameter
+
+__all__ = ["GradientClipByValue", "GradientClipByNorm",
+           "GradientClipByGlobalNorm", "set_gradient_clip",
+           "append_gradient_clip_ops", "ErrorClipByValue"]
+
+
+class BaseGradientClipAttr:
+    def _process(self, params_grads):
+        raise NotImplementedError
+
+
+class ErrorClipByValue:
+    """Kept for API parity (ref clip.py ErrorClipByValue); forward-error
+    clipping has no role when backward is exact vjp."""
+
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+class GradientClipByValue(BaseGradientClipAttr):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip_one(self, block, grad):
+        out = block.create_var(shape=grad.shape, dtype=str(grad.dtype))
+        block.append_op("clip", {"X": grad}, {"Out": out},
+                        {"min": self.min, "max": self.max})
+        return out
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            block = p.block.program.global_block()
+            out.append((p, self._clip_one(block, g)))
+        return out
+
+
+class GradientClipByNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            block = p.block.program.global_block()
+            o = block.create_var(shape=g.shape, dtype=str(g.dtype))
+            block.append_op("clip_by_norm", {"X": g}, {"Out": o},
+                            {"max_norm": self.clip_norm})
+            out.append((p, o))
+        return out
+
+
+class GradientClipByGlobalNorm(BaseGradientClipAttr):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _process(self, params_grads):
+        if not params_grads:
+            return params_grads
+        block = params_grads[0][0].block.program.global_block()
+        sq_norms = []
+        for _, g in params_grads:
+            sq = block.create_var(shape=(), dtype=str(g.dtype))
+            block.append_op("squared_l2_norm", {"X": g}, {"Out": sq}, {})
+            sq_norms.append(sq)
+        total = block.create_var(shape=(), dtype="float32")
+        block.append_op("sum", {"X": sq_norms}, {"Out": total}, {})
+        gnorm = block.create_var(shape=(), dtype="float32")
+        block.append_op("sqrt", {"X": total}, {"Out": gnorm}, {})
+        # scale = clip / max(gnorm, clip)
+        maxed = block.create_var(shape=(), dtype="float32")
+        clip_c = block.create_var(shape=(), dtype="float32")
+        block.append_op("fill_constant", outputs={"Out": clip_c},
+                        attrs={"shape": (), "dtype": "float32",
+                               "value": self.clip_norm})
+        block.append_op("elementwise_max", {"X": gnorm, "Y": clip_c},
+                        {"Out": maxed}, {})
+        factor = block.create_var(shape=(), dtype="float32")
+        block.append_op("elementwise_div", {"X": clip_c, "Y": maxed},
+                        {"Out": factor}, {})
+        out = []
+        for p, g in params_grads:
+            o = block.create_var(shape=g.shape, dtype=str(g.dtype))
+            block.append_op("elementwise_mul", {"X": g, "Y": factor},
+                            {"Out": o}, {"axis": -1})
+            out.append((p, o))
+        return out
+
+
+_global_clip = None
+
+
+def set_gradient_clip(clip, param_list=None, program=None):
+    """Set a program-wide clip strategy (ref ``clip.py`` set_gradient_clip);
+    per-param ``ParamAttr.gradient_clip`` overrides it."""
+    global _global_clip
+    _global_clip = clip
+    if param_list:
+        for p in param_list:
+            if isinstance(p, Parameter):
+                p.gradient_clip_attr = clip
+
+
+def append_gradient_clip_ops(params_grads):
+    # partition by clip attr: per-param attrs first, else global
+    if not params_grads:
+        return params_grads
+    default = []
+    out = []
+    for p, g in params_grads:
+        attr = getattr(p, "gradient_clip_attr", None) or _global_clip
+        if attr is None:
+            out.append((p, g))
+        else:
+            default.append((attr, p, g))
+    # group global-norm clips so the norm is computed across the whole group
+    by_attr = {}
+    for attr, p, g in default:
+        by_attr.setdefault(id(attr), (attr, []))[1].append((p, g))
+    # Sparse (rows, values) grads flow through the same clip ops: the
+    # autodiff emits them row-merged with zeros in duplicate slots, so a
+    # squared_l2_norm over the values equals the dense-grad norm, and an
+    # elementwise scale of the values scales the logical dense grad (ref
+    # clip.py merges SelectedRows before clipping for the same reason).
+    sparse_rows = {p.name: g.sparse_rows_var for _, p, g in default
+                   if getattr(g, "sparse_rows_var", None) is not None}
+    for attr, group in by_attr.values():
+        processed = attr._process(group)
+        for p, g in processed:
+            if p.name in sparse_rows:
+                g.sparse_rows_var = sparse_rows[p.name]
+        out.extend(processed)
+    return out

@@ -1,0 +1,4 @@
+"""contrib: quantization + slim (ref ``python/paddle/fluid/contrib/``)."""
+
+from . import quantize  # noqa: F401
+from . import slim  # noqa: F401

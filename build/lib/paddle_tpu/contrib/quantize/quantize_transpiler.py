@@ -1,0 +1,174 @@
+"""Quantization-aware training transpiler.
+
+Reference: ``python/paddle/fluid/contrib/quantize/quantize_transpiler.py``
+(``QuantizeTranspiler.training_transpile:145``, ``freeze_program:255``,
+``convert_to_int8:371``). Same program-rewriting capability, re-designed
+for the jax/XLA path: fake-quant ops carry straight-through gradients
+(``core/opimpl/quant_ops.py``) so the QAT backward needs no grad-op
+surgery, and per-channel weight scales map onto the MXU's preference for
+channel-major quantized weights.
+
+Contract: call ``training_transpile`` BEFORE ``minimize`` — the autodiff
+op records the forward op list at minimize time, so fake-quant ops
+inserted afterwards would be invisible to the backward.
+"""
+
+import numpy as np
+
+from ...core import framework
+from ...core.framework import Operator
+
+__all__ = ["QuantizeTranspiler"]
+
+_QUANTIZABLE = {
+    "mul": ("X", "Y"),
+    "conv2d": ("Input", "Filter"),
+    "depthwise_conv2d": ("Input", "Filter"),
+}
+
+
+class QuantizeTranspiler:
+    def __init__(self, weight_bits=8, activation_bits=8,
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_quantize_type="channel_wise_abs_max",
+                 moving_rate=0.9):
+        if activation_quantize_type not in ("abs_max",
+                                            "moving_average_abs_max"):
+            raise ValueError("unknown activation_quantize_type %r"
+                             % activation_quantize_type)
+        self.weight_bits = weight_bits
+        self.activation_bits = activation_bits
+        self.act_type = activation_quantize_type
+        self.weight_type = weight_quantize_type
+        self.moving_rate = moving_rate
+        self._weight_quants = {}  # weight var name -> quant axis
+
+    # -- QAT ----------------------------------------------------------------
+    def training_transpile(self, program=None, startup_program=None):
+        """Insert fake-quant ops on the inputs + weights of every
+        quantizable op, in place."""
+        program = program or framework.default_main_program()
+        startup = startup_program or framework.default_startup_program()
+        block = program.global_block()
+        if any(op.type == "autodiff" for op in block.ops):
+            raise RuntimeError("training_transpile must run BEFORE "
+                               "minimize()/append_backward")
+        new_ops = []
+        quanted = {}  # var name -> already-quantized replacement Variable
+        for op in block.ops:
+            if op.type in _QUANTIZABLE:
+                act_slot, w_slot = _QUANTIZABLE[op.type]
+                for slot in (act_slot, w_slot):
+                    src = op.input(slot)
+                    if src is None:
+                        continue
+                    if src.name in quanted:
+                        op.inputs[slot] = [quanted[src.name]]
+                        continue
+                    is_weight = (slot == w_slot) and src.persistable
+                    qv = self._insert_quant(block, startup, new_ops, src,
+                                            is_weight, op)
+                    quanted[src.name] = qv
+                    op.inputs[slot] = [qv]
+            new_ops.append(op)
+        block.ops = new_ops
+        program._version += 1
+        return program
+
+    def _insert_quant(self, block, startup, new_ops, src, is_weight, op):
+        qv = block.create_var(
+            name=src.name + ".quantized", shape=src.shape, dtype="float32")
+        sv = block.create_var(
+            name=src.name + ".quant_scale", shape=(), dtype="float32")
+        if is_weight:
+            # conv weights OIHW -> channel axis 0; mul weights [in, out]
+            # -> output-channel axis 1
+            axis = 0 if op.type != "mul" else 1
+            self._weight_quants[src.name] = axis
+            new_ops.append(Operator(
+                block, "fake_channel_wise_quantize_abs_max",
+                {"X": src}, {"Out": qv, "OutScale": sv},
+                {"bit_length": self.weight_bits, "quant_axis": axis}))
+        elif self.act_type == "abs_max":
+            new_ops.append(Operator(
+                block, "fake_quantize_abs_max", {"X": src},
+                {"Out": qv, "OutScale": sv},
+                {"bit_length": self.activation_bits}))
+        else:
+            state = block.create_var(name=src.name + ".quant_state",
+                                     shape=(), dtype="float32",
+                                     persistable=True)
+            sb = startup.global_block()
+            ssv = sb.create_var(name=state.name, shape=(),
+                                dtype="float32", persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": ssv},
+                         attrs={"shape": (), "dtype": "float32",
+                                "value": 0.0})
+            new_ops.append(Operator(
+                block, "fake_quantize_moving_average_abs_max",
+                {"X": src, "InScale": state},
+                {"Out": qv, "OutScale": state},
+                {"bit_length": self.activation_bits,
+                 "moving_rate": self.moving_rate}))
+        return qv
+
+    # -- deployment ---------------------------------------------------------
+    def freeze_program(self, program, place=None, scope=None):
+        """Bake trained weights onto the quantization grid (in the scope)
+        and drop the weight fake-quant ops; activation quant ops switch to
+        their frozen (is_test) scales. Ref ``freeze_program:255``."""
+        from ...core.executor import global_scope
+
+        scope = scope or global_scope()
+        block = program.global_block()
+        kept = []
+        for op in block.ops:
+            if (op.type == "fake_channel_wise_quantize_abs_max"
+                    and op.input("X").name in self._weight_quants):
+                wname = op.input("X").name
+                w = np.asarray(scope.get(wname))
+                axis = self._weight_quants[wname]
+                qw, _ = self._quant_np(w, axis)
+                scope.set(wname, qw)  # weight now ON the int grid
+                # rewire consumers of the quantized var back to the
+                # (now pre-quantized) weight
+                qname = op.output("Out").name
+                for other in block.ops:
+                    for slot, vs in other.inputs.items():
+                        other.inputs[slot] = [
+                            op.input("X") if v.name == qname else v
+                            for v in vs]
+                continue
+            if op.type in ("fake_quantize_abs_max",
+                           "fake_quantize_moving_average_abs_max"):
+                op.attrs["is_test"] = True
+            kept.append(op)
+        block.ops = kept
+        program._version += 1
+        return program
+
+    def convert_to_int8(self, program, scope=None):
+        """Return {weight name: (int8 array, per-channel float scales)} for
+        deployment storage (ref ``convert_to_int8:371`` rewrites vars to
+        INT8 tensors; serialization-ready dict here)."""
+        from ...core.executor import global_scope
+
+        scope = scope or global_scope()
+        out = {}
+        for wname, axis in self._weight_quants.items():
+            w = np.asarray(scope.get(wname))
+            _, scale = self._quant_np(w, axis)
+            qmax = float(2 ** (self.weight_bits - 1) - 1)
+            i8 = np.round(
+                np.clip(w / np.maximum(scale, 1e-8), -1, 1) * qmax
+            ).astype(np.int8)
+            out[wname] = (i8, scale.reshape(-1))
+        return out
+
+    def _quant_np(self, w, axis):
+        red = tuple(i for i in range(w.ndim) if i != axis)
+        scale = np.max(np.abs(w), axis=red, keepdims=True)
+        qmax = float(2 ** (self.weight_bits - 1) - 1)
+        s = np.maximum(scale, 1e-8)
+        qw = np.round(np.clip(w / s, -1, 1) * qmax) / qmax * s
+        return qw.astype(w.dtype), scale

@@ -1,0 +1,15 @@
+"""Core runtime: symbolic graph, op registry + impls, executor, compiler."""
+
+from . import framework
+from . import unique_name
+from . import op_registry
+from . import opimpl  # registers all op impls
+from .framework import (  # noqa: F401
+    Program, Variable, Parameter, Operator, Block,
+    default_main_program, default_startup_program, program_guard,
+    name_scope)
+from .executor import (  # noqa: F401
+    Executor, Scope, global_scope, scope_guard,
+    XLAPlace, TPUPlace, CPUPlace, CUDAPlace)
+from .compiler import CompiledProgram, BuildStrategy, ExecutionStrategy  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401

@@ -1,0 +1,34 @@
+"""Attention + sampling op registrations (bridge to ``paddle_tpu.ops``)."""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put, next_rng
+
+
+@register("flash_attention")
+def _flash_attention_op(env, op):
+    from ...ops.flash_attention import flash_attention
+
+    from ..op_registry import mxu_cast
+
+    q = get(env, op.input("Q"))
+    k = get(env, op.input("K"))
+    v = get(env, op.input("V"))
+    bias = get(env, op.input("Bias"))
+    out_dtype = q.dtype
+    q, k, v = mxu_cast(q, k, v)
+    dropout = op.attr("dropout_rate", 0.0)
+    rng = next_rng(env) if dropout > 0.0 else None
+    out = flash_attention(q, k, v, op.attr("num_heads", 1), bias=bias,
+                          causal=op.attr("causal", False),
+                          dropout_rate=dropout, rng=rng)
+    put(env, op.output("Out"), out.astype(out_dtype))
+
+
+@register("sampling_id")
+def _sampling_id(env, op):
+    x = get(env, op.input("X"))  # [B, C] probabilities
+    put(env, op.output("Out"),
+        jax.random.categorical(next_rng(env), jnp.log(jnp.maximum(x, 1e-20)),
+                               axis=-1).astype(jnp.int64))

@@ -1,0 +1,119 @@
+"""TensorArray + beam-search decode ops.
+
+Reference: ``operators/controlflow/tensor_array_read_write_op.cc`` (LoD
+TensorArray), ``operators/beam_search_op.cc`` (per-step beam pruning over
+LoD candidate lists) and ``operators/beam_search_decode_op.cc`` (backtrack
+to sentences). The TPU-native re-design replaces the dynamically-growing
+LoD arrays with fixed-capacity stacked buffers (static shapes for XLA) and
+the per-sequence LoD beam bookkeeping with dense [B, K] beam tensors —
+pruning is one ``lax.top_k`` over [B, K*V] and lineage is recovered by a
+reverse ``lax.scan`` over recorded parent pointers.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put
+
+
+@register("array_write")
+def _array_write(env, op):
+    """Write X at index I of a fixed-capacity stacked array. The array is
+    created (zeros, ``capacity`` slots) on first write; Out aliases the
+    Array var so writes inside while bodies update the loop carry."""
+    x = get(env, op.input("X"))
+    i = get(env, op.input("I")).reshape(()).astype(jnp.int32)
+    arr_var = op.output("Out")
+    if arr_var.name in env:
+        arr = env[arr_var.name]
+    else:
+        arr = jnp.zeros((op.attr("capacity"),) + x.shape, x.dtype)
+    put(env, arr_var, jax.lax.dynamic_update_index_in_dim(arr, x, i, 0))
+    # dynamic fill level for array_length (while_block carries it alongside
+    # the array so it survives loop iterations)
+    key = arr_var.name + "@LEN"
+    env[key] = jnp.maximum(env.get(key, jnp.int32(0)), i + 1)
+
+
+@register("array_read")
+def _array_read(env, op):
+    arr = get(env, op.input("Array"))
+    i = get(env, op.input("I")).reshape(()).astype(jnp.int32)
+    put(env, op.output("Out"),
+        jax.lax.dynamic_index_in_dim(arr, i, 0, keepdims=False))
+
+
+@register("array_length")
+def _array_length(env, op):
+    """Number of elements written so far: 1 + the highest index passed to
+    ``array_write`` (parity with the reference's growing LoDTensorArray;
+    the buffer's static capacity is just its allocation)."""
+    arr_name = op.input("Array").name
+    n = env.get(arr_name + "@LEN", jnp.int32(env[arr_name].shape[0]))
+    put(env, op.output("Out"), n.astype(jnp.int64))
+
+
+@register("beam_search_step")
+def _beam_search_step(env, op):
+    """One beam-pruning step (ref ``beam_search_op.cc``): combine the K
+    running hypotheses with next-token log-probs and keep the global top-K
+    per batch item. Finished beams (last token == end_id) only extend with
+    end_id at zero added score, so their cumulative score is frozen."""
+    pre_ids = get(env, op.input("PreIds"))          # [B, K] int
+    pre_scores = get(env, op.input("PreScores"))    # [B, K] float
+    scores = get(env, op.input("Scores"))           # [B, K, V] log-probs
+    end_id = op.attr("end_id")
+    b, k, v = scores.shape
+    finished = pre_ids == end_id
+    end_row = jnp.where(jnp.arange(v) == end_id, 0.0, -1e9)
+    cont = jnp.where(finished[..., None], end_row, scores)
+    flat = (pre_scores[..., None] + cont).reshape(b, k * v)
+    top_scores, top_idx = jax.lax.top_k(flat, k)
+    put(env, op.output("SelectedIds"), (top_idx % v).astype(pre_ids.dtype))
+    put(env, op.output("SelectedScores"), top_scores)
+    put(env, op.output("ParentIdx"), (top_idx // v).astype(jnp.int32))
+
+
+@register("beam_search_gather")
+def _beam_search_gather(env, op):
+    """Reorder per-beam state by parent index: X [B, K, ...], Ids [B, K] ->
+    Out[b, j] = X[b, Ids[b, j]] (the reference reorders via LoD offsets)."""
+    x = get(env, op.input("X"))
+    idx = get(env, op.input("Ids")).astype(jnp.int32)
+    idx = idx.reshape(idx.shape + (1,) * (x.ndim - 2))
+    put(env, op.output("Out"),
+        jnp.take_along_axis(x, jnp.broadcast_to(
+            idx, idx.shape[:2] + x.shape[2:]), axis=1))
+
+
+@register("beam_search_decode")
+def _beam_search_decode(env, op):
+    """Backtrack recorded (ids, parents) per step into full sentences (ref
+    ``beam_search_decode_op.cc``). IdsArray/ParentsArray: [T, B, K];
+    Length: scalar number of steps actually produced (steps >= Length are
+    treated as pass-through). Outputs SentenceIds [B, K, T] padded with
+    end_id and SentenceScores passed through from the final beam scores."""
+    ids_arr = get(env, op.input("IdsArray"))
+    par_arr = get(env, op.input("ParentsArray"))
+    length = get(env, op.input("Length")).reshape(()).astype(jnp.int32)
+    final_scores = get(env, op.input("FinalScores"))
+    end_id = op.attr("end_id")
+    t_cap, b, k = ids_arr.shape
+
+    init_beam = jnp.broadcast_to(jnp.arange(k, dtype=jnp.int32), (b, k))
+
+    def back(beam_idx, xs):
+        t, ids_t, par_t = xs
+        live = t < length
+        tok = jnp.take_along_axis(ids_t, beam_idx, axis=1)
+        parent = jnp.take_along_axis(par_t, beam_idx, axis=1)
+        tok = jnp.where(live, tok, end_id)
+        parent = jnp.where(live, parent, beam_idx)
+        return parent, tok
+
+    ts = jnp.arange(t_cap - 1, -1, -1)
+    _, toks_rev = jax.lax.scan(
+        back, init_beam, (ts, ids_arr[::-1], par_arr[::-1]))
+    sent = jnp.flip(toks_rev, axis=0)            # [T, B, K]
+    put(env, op.output("SentenceIds"), jnp.transpose(sent, (1, 2, 0)))
+    put(env, op.output("SentenceScores"), final_scores)

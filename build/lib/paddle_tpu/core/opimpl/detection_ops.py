@@ -1,0 +1,1017 @@
+"""Detection ops (subset; ref ``paddle/fluid/operators/detection/``).
+
+Static-shape friendly members implemented for round 1: prior_box,
+box_coder, iou_similarity, roi_pool/align on fixed ROI counts. NMS-style
+dynamic-output ops are provided with fixed-size outputs + validity masks
+(XLA cannot produce data-dependent shapes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..op_registry import register, get, put
+
+
+@register("iou_similarity")
+def _iou_similarity(env, op):
+    x = get(env, op.input("X"))  # [N, 4] xmin ymin xmax ymax
+    y = get(env, op.input("Y"))  # [M, 4]
+    area_x = (x[:, 2] - x[:, 0]) * (x[:, 3] - x[:, 1])
+    area_y = (y[:, 2] - y[:, 0]) * (y[:, 3] - y[:, 1])
+    lt = jnp.maximum(x[:, None, :2], y[None, :, :2])
+    rb = jnp.minimum(x[:, None, 2:], y[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_x[:, None] + area_y[None, :] - inter
+    put(env, op.output("Out"), inter / jnp.maximum(union, 1e-10))
+
+
+@register("box_coder")
+def _box_coder(env, op):
+    prior = get(env, op.input("PriorBox"))  # [M, 4]
+    pvar = get(env, op.input("PriorBoxVar"))
+    target = get(env, op.input("TargetBox"))
+    code_type = op.attr("code_type", "encode_center_size")
+    norm = op.attr("box_normalized", True)
+    one = 0.0 if norm else 1.0
+    pw = prior[:, 2] - prior[:, 0] + one
+    ph = prior[:, 3] - prior[:, 1] + one
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if pvar is None:
+        pvar = jnp.ones((4,), prior.dtype)
+    if pvar.ndim == 2:
+        v0, v1, v2, v3 = pvar[:, 0], pvar[:, 1], pvar[:, 2], pvar[:, 3]
+    else:
+        v0, v1, v2, v3 = pvar[0], pvar[1], pvar[2], pvar[3]
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + one
+        th = target[:, 3] - target[:, 1] + one
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        ox = (tcx[:, None] - pcx[None, :]) / pw[None, :] / v0
+        oy = (tcy[:, None] - pcy[None, :]) / ph[None, :] / v1
+        ow = jnp.log(tw[:, None] / pw[None, :]) / v2
+        oh = jnp.log(th[:, None] / ph[None, :]) / v3
+        put(env, op.output("OutputBox"), jnp.stack([ox, oy, ow, oh], axis=-1))
+    else:  # decode_center_size; target [N, M, 4]
+        ox = v0 * target[..., 0] * pw + pcx
+        oy = v1 * target[..., 1] * ph + pcy
+        ow = jnp.exp(v2 * target[..., 2]) * pw
+        oh = jnp.exp(v3 * target[..., 3]) * ph
+        out = jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                         ox + ow * 0.5 - one, oy + oh * 0.5 - one], axis=-1)
+        put(env, op.output("OutputBox"), out)
+
+
+@register("prior_box")
+def _prior_box(env, op):
+    feat = get(env, op.input("Input"))  # NCHW feature map
+    img = get(env, op.input("Image"))
+    min_sizes = op.attr("min_sizes")
+    max_sizes = op.attr("max_sizes", [])
+    ratios = op.attr("aspect_ratios", [1.0])
+    flip = op.attr("flip", False)
+    clip = op.attr("clip", False)
+    step_w = op.attr("step_w", 0.0)
+    step_h = op.attr("step_h", 0.0)
+    offset = op.attr("offset", 0.5)
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = feat.shape[2], feat.shape[3]
+    img_h, img_w = img.shape[2], img.shape[3]
+    sw = step_w or img_w / w
+    sh = step_h or img_h / h
+    ars = [1.0]
+    for r in ratios:
+        if all(abs(r - a) > 1e-6 for a in ars):
+            ars.append(r)
+            if flip:
+                ars.append(1.0 / r)
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            bw = ms * np.sqrt(ar) * 0.5
+            bh = ms / np.sqrt(ar) * 0.5
+            boxes.append((bw, bh))
+        if max_sizes:
+            for mxs in max_sizes:
+                s = np.sqrt(ms * mxs) * 0.5
+                boxes.append((s, s))
+    cx = (jnp.arange(w) + offset) * sw
+    cy = (jnp.arange(h) + offset) * sh
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    all_boxes = []
+    for bw, bh in boxes:
+        b = jnp.stack([(cxg - bw) / img_w, (cyg - bh) / img_h,
+                       (cxg + bw) / img_w, (cyg + bh) / img_h], axis=-1)
+        all_boxes.append(b)
+    out = jnp.stack(all_boxes, axis=2)  # [H, W, num_priors, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    put(env, op.output("Boxes"), out)
+    put(env, op.output("Variances"), var)
+
+
+@register("roi_align")
+def _roi_align(env, op):
+    x = get(env, op.input("X"))  # [N, C, H, W]
+    rois = get(env, op.input("ROIs"))  # [R, 4] in image coords; batch 0 only
+    pooled_h = op.attr("pooled_height", 1)
+    pooled_w = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        ys = y1 + (jnp.arange(pooled_h) + 0.5) * rh / pooled_h
+        xs = x1 + (jnp.arange(pooled_w) + 0.5) * rw / pooled_w
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, w - 1)
+        y1i = jnp.clip(y0 + 1, 0, h - 1)
+        x1i = jnp.clip(x0 + 1, 0, w - 1)
+        wy = (ys - y0)[:, None]
+        wx = (xs - x0)[None, :]
+        img = x[0]
+        g = lambda yy, xx: img[:, yy][:, :, xx]
+        return (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1i, x0) * wy * (1 - wx)
+                + g(y0, x1i) * (1 - wy) * wx + g(y1i, x1i) * wy * wx)
+
+    put(env, op.output("Out"), jax.vmap(one_roi)(rois))
+
+
+@register("roi_pool")
+def _roi_pool(env, op):
+    x = get(env, op.input("X"))
+    rois = get(env, op.input("ROIs"))
+    pooled_h = op.attr("pooled_height", 1)
+    pooled_w = op.attr("pooled_width", 1)
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one_roi(roi):
+        x1 = jnp.round(roi[0] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = x[0]
+        ys = jnp.arange(h)
+        xs = jnp.arange(w)
+        outs = []
+        for ph in range(pooled_h):
+            for pw in range(pooled_w):
+                ys_lo = y1 + (ph * rh) // pooled_h
+                ys_hi = y1 + ((ph + 1) * rh + pooled_h - 1) // pooled_h
+                xs_lo = x1 + (pw * rw) // pooled_w
+                xs_hi = x1 + ((pw + 1) * rw + pooled_w - 1) // pooled_w
+                m = ((ys >= ys_lo) & (ys < jnp.maximum(ys_hi, ys_lo + 1)))[None, :, None] & \
+                    ((xs >= xs_lo) & (xs < jnp.maximum(xs_hi, xs_lo + 1)))[None, None, :]
+                outs.append(jnp.max(jnp.where(m, img, -jnp.inf), axis=(1, 2)))
+        return jnp.stack(outs, axis=-1).reshape(c, pooled_h, pooled_w)
+
+    put(env, op.output("Out"), jax.vmap(one_roi)(rois))
+
+
+@register("anchor_generator")
+def _anchor_generator(env, op):
+    feat = get(env, op.input("Input"))
+    sizes = op.attr("anchor_sizes")
+    ratios = op.attr("aspect_ratios")
+    stride = op.attr("stride")
+    offset = op.attr("offset", 0.5)
+    variances = op.attr("variances", [0.1, 0.1, 0.2, 0.2])
+    h, w = feat.shape[2], feat.shape[3]
+    cx = (jnp.arange(w) + offset) * stride[0]
+    cy = (jnp.arange(h) + offset) * stride[1]
+    cxg, cyg = jnp.meshgrid(cx, cy)
+    anchors = []
+    for r in ratios:
+        for s in sizes:
+            aw = s * np.sqrt(1.0 / r) * 0.5
+            ah = s * np.sqrt(r) * 0.5
+            anchors.append(jnp.stack(
+                [cxg - aw, cyg - ah, cxg + aw, cyg + ah], axis=-1))
+    out = jnp.stack(anchors, axis=2)
+    var = jnp.broadcast_to(jnp.asarray(variances, out.dtype), out.shape)
+    put(env, op.output("Anchors"), out)
+    put(env, op.output("Variances"), var)
+
+
+# ---------------------------------------------------------------------------
+# NMS family (ref multiclass_nms_op.cc, generate_proposals_op.cc)
+# ---------------------------------------------------------------------------
+
+def _iou_matrix(a, b, norm=True):
+    """[..., M, 4] x [..., N, 4] -> [..., M, N] IoU."""
+    one = 0.0 if norm else 1.0
+    area = lambda t: ((t[..., 2] - t[..., 0] + one)
+                      * (t[..., 3] - t[..., 1] + one))
+    lt = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    rb = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt + one, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area(a)[..., :, None] + area(b)[..., None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _greedy_nms(boxes, scores, iou_thresh, max_keep, score_thresh=-1e30,
+                eta=1.0, norm=True):
+    """Greedy NMS with static output size.
+
+    boxes [M, 4], scores [M] -> (keep_idx [max_keep] int32 (padded 0),
+    keep_valid [max_keep] bool). XLA-friendly: one fori_loop, each step
+    picks the live argmax and suppresses by IoU (ref nms kernel in
+    ``multiclass_nms_op.cc:90``; adaptive eta supported)."""
+    m = boxes.shape[0]
+    iou = _iou_matrix(boxes, boxes, norm)  # [M, M]
+
+    def body(i, state):
+        alive, thresh, idxs, valid = state
+        masked = jnp.where(alive, scores, -jnp.inf)
+        j = jnp.argmax(masked)
+        ok = masked[j] > jnp.maximum(score_thresh, -1e30)
+        idxs = idxs.at[i].set(jnp.where(ok, j, 0).astype(jnp.int32))
+        valid = valid.at[i].set(ok)
+        # suppress j itself + IoU-overlapping survivors
+        alive = alive & (iou[j] <= thresh) & \
+            (jnp.arange(m) != j) & ok
+        # adaptive NMS decays only while the threshold is above 0.5 and a
+        # box was actually kept (ref multiclass_nms_op.cc adaptive eta)
+        thresh = jnp.where((eta < 1.0) & (thresh > 0.5) & ok,
+                           thresh * eta, thresh)
+        return alive, thresh, idxs, valid
+
+    init = (jnp.ones((m,), bool), jnp.float32(iou_thresh),
+            jnp.zeros((max_keep,), jnp.int32),
+            jnp.zeros((max_keep,), bool))
+    _, _, idxs, valid = jax.lax.fori_loop(0, min(max_keep, m), body, init)
+    return idxs, valid
+
+
+@register("multiclass_nms")
+def _multiclass_nms(env, op):
+    """Ref ``multiclass_nms_op.cc``: per-class NMS then cross-class top-K.
+
+    Fixed-shape re-design of the LoD output: Out [N, keep_top_k, 6]
+    (label, score, x1, y1, x2, y2; pad rows are -1, the reference's
+    no-detection marker) + Count [N] valid rows."""
+    boxes = get(env, op.input("BBoxes"))   # [N, M, 4]
+    scores = get(env, op.input("Scores"))  # [N, C, M]
+    bg = op.attr("background_label", 0)
+    score_thresh = op.attr("score_threshold", 0.0)
+    nms_top_k = int(op.attr("nms_top_k", 64))
+    keep_top_k = int(op.attr("keep_top_k", 100))
+    nms_thresh = op.attr("nms_threshold", 0.3)
+    eta = op.attr("nms_eta", 1.0)
+    norm = op.attr("normalized", True)
+    n, c, m = scores.shape
+    top = min(nms_top_k if nms_top_k > 0 else m, m)
+
+    def one_class(cls_scores, cls_boxes):
+        idxs, valid = _greedy_nms(cls_boxes, cls_scores, nms_thresh, top,
+                                  score_thresh, eta, norm)
+        return (cls_scores[idxs] * valid - (1.0 - valid) * 1e30,
+                cls_boxes[idxs], valid)
+
+    def one_image(bx, sc):
+        # vmap classes; bx [M, 4], sc [C, M]
+        s, b, v = jax.vmap(lambda s_c: one_class(s_c, bx))(sc)
+        # [C, top] flatten, mask background, global top keep_top_k
+        labels = jnp.broadcast_to(jnp.arange(c)[:, None], (c, top))
+        flat_s = s.reshape(-1)
+        flat_s = jnp.where(labels.reshape(-1) == bg, -1e30, flat_s)
+        k = min(keep_top_k if keep_top_k > 0 else c * top, c * top)
+        best_s, best_i = jax.lax.top_k(flat_s, k)
+        ok = best_s > jnp.maximum(score_thresh, -1e29)
+        out = jnp.concatenate([
+            jnp.where(ok, labels.reshape(-1)[best_i], -1)[:, None]
+            .astype(jnp.float32),
+            jnp.where(ok, best_s, -1)[:, None],
+            jnp.where(ok[:, None], b.reshape(-1, 4)[best_i], -1.0),
+        ], axis=1)
+        return out, jnp.sum(ok.astype(jnp.int32))
+
+    out, count = jax.vmap(one_image)(boxes, scores)
+    put(env, op.output("Out"), out)
+    if op.output("Count") is not None:
+        put(env, op.output("Count"), count)
+
+
+@register("box_clip")
+def _box_clip(env, op):
+    """Ref ``box_clip_op.cc``: clip boxes to image extent from ImInfo
+    [N, 3] (h, w, scale)."""
+    boxes = get(env, op.input("Input"))   # [N, M, 4]
+    im_info = get(env, op.input("ImInfo"))
+    h = im_info[:, 0] / im_info[:, 2]
+    w = im_info[:, 1] / im_info[:, 2]
+    exp = (slice(None),) + (None,) * (boxes.ndim - 2)
+    x1 = jnp.clip(boxes[..., 0], 0, (w - 1)[exp])
+    y1 = jnp.clip(boxes[..., 1], 0, (h - 1)[exp])
+    x2 = jnp.clip(boxes[..., 2], 0, (w - 1)[exp])
+    y2 = jnp.clip(boxes[..., 3], 0, (h - 1)[exp])
+    put(env, op.output("Output"), jnp.stack([x1, y1, x2, y2], axis=-1))
+
+
+@register("generate_proposals")
+def _generate_proposals(env, op):
+    """Ref ``generate_proposals_op.cc``: decode RPN deltas at anchors,
+    clip, drop tiny boxes (masked, not filtered — static shapes), pre-NMS
+    top-N, NMS, post-NMS top-N. Outputs [N, post_nms_topN, 4] + RoiProbs +
+    Count instead of LoD."""
+    scores = get(env, op.input("Scores"))       # [N, A, H, W]
+    deltas = get(env, op.input("BboxDeltas"))   # [N, 4A, H, W]
+    im_info = get(env, op.input("ImInfo"))      # [N, 3]
+    anchors = get(env, op.input("Anchors"))     # [H, W, A, 4]
+    variances = get(env, op.input("Variances"))
+    pre_n = int(op.attr("pre_nms_topN", 6000))
+    post_n = int(op.attr("post_nms_topN", 1000))
+    nms_thresh = op.attr("nms_thresh", 0.7)
+    min_size = op.attr("min_size", 0.1)
+    eta = op.attr("eta", 1.0)
+
+    n, a, h, w = scores.shape
+    total = a * h * w
+    anc = anchors.transpose(2, 0, 1, 3).reshape(total, 4)
+    var = variances.transpose(2, 0, 1, 3).reshape(total, 4) \
+        if variances is not None and variances.ndim == 4 else None
+
+    def one(sc, dl, info):
+        s = sc.reshape(total)
+        d = dl.reshape(a, 4, h, w).transpose(0, 2, 3, 1).reshape(total, 4)
+        if var is not None:
+            d = d * var
+        aw = anc[:, 2] - anc[:, 0] + 1.0
+        ah = anc[:, 3] - anc[:, 1] + 1.0
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        bw = jnp.exp(jnp.minimum(d[:, 2], 10.0)) * aw
+        bh = jnp.exp(jnp.minimum(d[:, 3], 10.0)) * ah
+        boxes = jnp.stack([cx - bw * 0.5, cy - bh * 0.5,
+                           cx + bw * 0.5 - 1, cy + bh * 0.5 - 1], axis=1)
+        # clip to the (scaled) image extent the boxes live in — only
+        # box_clip divides by scale (ref generate_proposals_op.cc clips to
+        # im_info[0]/[1] directly)
+        ih = info[0]
+        iw = info[1]
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, iw - 1), jnp.clip(boxes[:, 1], 0, ih - 1),
+            jnp.clip(boxes[:, 2], 0, iw - 1), jnp.clip(boxes[:, 3], 0, ih - 1),
+        ], axis=1)
+        ms = min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= ms)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= ms))
+        s = jnp.where(keep, s, -1e30)
+        k = min(pre_n, total)
+        top_s, top_i = jax.lax.top_k(s, k)
+        top_b = boxes[top_i]
+        idxs, valid = _greedy_nms(top_b, top_s, nms_thresh, post_n,
+                                  score_thresh=-1e29, eta=eta)
+        rois = jnp.where(valid[:, None], top_b[idxs], 0.0)
+        probs = jnp.where(valid, top_s[idxs], 0.0)
+        return rois, probs, jnp.sum(valid.astype(jnp.int32))
+
+    rois, probs, count = jax.vmap(one)(scores, deltas, im_info)
+    put(env, op.output("RpnRois"), rois)
+    put(env, op.output("RpnRoiProbs"), probs)
+    if op.output("Count") is not None:
+        put(env, op.output("Count"), count)
+
+
+# ---------------------------------------------------------------------------
+# matching / target assignment (SSD training path)
+# ---------------------------------------------------------------------------
+
+@register("bipartite_match")
+def _bipartite_match(env, op):
+    """Ref ``bipartite_match_op.cc``: greedy global bipartite matching on a
+    [B, M, N] distance matrix (M gt rows, N prior columns). Outputs
+    ColToRowMatchIndices [B, N] (-1 unmatched) + ColToRowMatchDist.
+    match_type='per_prediction' also matches leftover columns whose best
+    row exceeds dist_threshold."""
+    dist = get(env, op.input("DistMat"))
+    match_type = op.attr("match_type", "bipartite")
+    thresh = op.attr("dist_threshold", 0.5)
+    b, m, n = dist.shape
+
+    def one(d):
+        def body(_, state):
+            d_live, col_idx, col_dist = state
+            flat = jnp.argmax(d_live)
+            i, j = flat // n, flat % n
+            ok = d_live[i, j] > 0
+            col_idx = col_idx.at[j].set(
+                jnp.where(ok, i, col_idx[j]).astype(jnp.int32))
+            col_dist = col_dist.at[j].set(
+                jnp.where(ok, d_live[i, j], col_dist[j]))
+            d_live = jnp.where(ok, d_live.at[i, :].set(-1.0)
+                               .at[:, j].set(-1.0), d_live)
+            return d_live, col_idx, col_dist
+
+        init = (d, jnp.full((n,), -1, jnp.int32), jnp.zeros((n,)))
+        _, col_idx, col_dist = jax.lax.fori_loop(
+            0, min(m, n), body, init)
+        if match_type == "per_prediction":
+            best_row = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best = jnp.max(d, axis=0)
+            extra = (col_idx < 0) & (best >= thresh)
+            col_idx = jnp.where(extra, best_row, col_idx)
+            col_dist = jnp.where(extra, best, col_dist)
+        return col_idx, col_dist
+
+    idx, dd = jax.vmap(one)(dist)
+    put(env, op.output("ColToRowMatchIndices"), idx)
+    put(env, op.output("ColToRowMatchDist"), dd.astype(dist.dtype))
+
+
+@register("target_assign")
+def _target_assign(env, op):
+    """Ref ``target_assign_op.cc``: out[b, j] = X[b, match[b, j]] where
+    matched, else mismatch_value; OutWeight 1/0."""
+    x = get(env, op.input("X"))                # [B, M, K]
+    match = get(env, op.input("MatchIndices"))  # [B, N]
+    mismatch = op.attr("mismatch_value", 0)
+    safe = jnp.maximum(match, 0)
+    gathered = jnp.take_along_axis(
+        x, safe[..., None].astype(jnp.int32), axis=1)
+    ok = (match >= 0)[..., None]
+    put(env, op.output("Out"),
+        jnp.where(ok, gathered, jnp.asarray(mismatch, x.dtype)))
+    put(env, op.output("OutWeight"),
+        jnp.broadcast_to(ok, gathered.shape[:2] + (1,))
+        .astype(jnp.float32))
+
+
+@register("mine_hard_examples")
+def _mine_hard_examples(env, op):
+    """Ref ``mine_hard_examples_op.cc`` (max_negative mining): keep the
+    top-(neg_pos_ratio x #pos) negatives by classification loss. Output
+    re-design: UpdatedMatchIndices [B, N] where kept negatives stay -1 and
+    discarded ones become -2 (reference emits a LoD NegIndices list;
+    callers here mask on == -1)."""
+    cls_loss = get(env, op.input("ClsLoss"))        # [B, N]
+    match = get(env, op.input("MatchIndices"))      # [B, N]
+    ratio = op.attr("neg_pos_ratio", 3.0)
+    b, n = cls_loss.shape
+
+    def one(loss, mi):
+        pos = mi >= 0
+        n_pos = jnp.sum(pos.astype(jnp.int32))
+        n_neg = jnp.minimum((n_pos.astype(jnp.float32) * ratio)
+                            .astype(jnp.int32), n)
+        neg_loss = jnp.where(pos, -jnp.inf, loss)
+        order = jnp.argsort(-neg_loss)  # negatives by loss desc
+        rank = jnp.zeros((n,), jnp.int32).at[order].set(jnp.arange(n)
+                                                        .astype(jnp.int32))
+        keep_neg = (~pos) & (rank < n_neg) & jnp.isfinite(neg_loss)
+        return jnp.where(pos, mi, jnp.where(keep_neg, -1, -2))
+
+    put(env, op.output("UpdatedMatchIndices"),
+        jax.vmap(one)(cls_loss, match).astype(jnp.int32))
+
+
+@register("polygon_box_transform")
+def _polygon_box_transform(env, op):
+    """Ref ``polygon_box_transform_op.cc``: for activated cells, turn
+    offset predictions into absolute quad coordinates (4x scaling grid)."""
+    x = get(env, op.input("Input"))  # [N, 8, H, W]
+    n, c, h, w = x.shape
+    gx = jnp.broadcast_to(jnp.arange(w, dtype=x.dtype) * 4, (h, w))
+    gy = jnp.broadcast_to((jnp.arange(h, dtype=x.dtype) * 4)[:, None],
+                          (h, w))
+    grid = jnp.stack([gx, gy] * (c // 2), axis=0)  # [8, H, W]
+    put(env, op.output("Output"), grid[None] - x)
+
+
+@register("density_prior_box")
+def _density_prior_box(env, op):
+    """Ref ``density_prior_box_op.cc``: dense anchor grid from fixed sizes
+    x fixed ratios x densities per cell."""
+    feat = get(env, op.input("Input"))   # [N, C, H, W]
+    image = get(env, op.input("Image"))  # [N, C, IH, IW]
+    fixed_sizes = op.attr("fixed_sizes") or []
+    fixed_ratios = op.attr("fixed_ratios") or [1.0]
+    densities = op.attr("densities") or []
+    variances = op.attr("variances") or [0.1, 0.1, 0.2, 0.2]
+    clip = op.attr("clip", False)
+    offset = op.attr("offset", 0.5)
+    sw = op.attr("step_w", 0.0)
+    sh = op.attr("step_h", 0.0)
+    h, w = feat.shape[2], feat.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = sw if sw > 0 else iw / w
+    step_h = sh if sh > 0 else ih / h
+
+    # the density grid steps by the AVERAGE step on both axes (ref
+    # density_prior_box_op.cc step_average), not per-axis steps
+    step_avg = 0.5 * (step_w + step_h)
+    boxes_per_cell = []
+    for size, density in zip(fixed_sizes, densities):
+        shift = int(step_avg / density)
+        for r in fixed_ratios:
+            bw = size * np.sqrt(r)
+            bh = size / np.sqrt(r)
+            for di in range(density):
+                for dj in range(density):
+                    cx_off = (shift / 2.0 + dj * shift - step_avg * 0.5)
+                    cy_off = (shift / 2.0 + di * shift - step_avg * 0.5)
+                    boxes_per_cell.append((cx_off, cy_off, bw, bh))
+    k = len(boxes_per_cell)
+    cy, cx = jnp.meshgrid(
+        (jnp.arange(h, dtype=jnp.float32) + offset) * step_h,
+        (jnp.arange(w, dtype=jnp.float32) + offset) * step_w,
+        indexing="ij")
+    cell = jnp.asarray(boxes_per_cell, dtype=jnp.float32)  # [K, 4]
+    ccx = cx[..., None] + cell[None, None, :, 0]
+    ccy = cy[..., None] + cell[None, None, :, 1]
+    bw = jnp.broadcast_to(cell[None, None, :, 2] * 0.5, ccx.shape)
+    bh = jnp.broadcast_to(cell[None, None, :, 3] * 0.5, ccx.shape)
+    out = jnp.stack([(ccx - bw) / iw, (ccy - bh) / ih,
+                     (ccx + bw) / iw, (ccy + bh) / ih], axis=-1)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variances, jnp.float32),
+                           out.shape)
+    put(env, op.output("Boxes"), out)
+    put(env, op.output("Variances"), var)
+
+
+@register("yolov3_loss")
+def _yolov3_loss(env, op):
+    """Ref ``yolov3_loss_op.cc``: single-scale YOLOv3 loss — sigmoid-CE for
+    x/y + objectness + class scores, squared error for w/h, gt matched to
+    its best-IoU anchor (by shape), predictions overlapping any gt above
+    ignore_thresh excluded from the no-object loss."""
+    x = get(env, op.input("X"))          # [N, mask*(5+cls), H, W]
+    gt_box = get(env, op.input("GTBox"))    # [N, B, 4] (cx cy w h, 0..1)
+    gt_label = get(env, op.input("GTLabel"))  # [N, B]
+    anchors = op.attr("anchors")             # flat [w0,h0,w1,h1,...]
+    mask = op.attr("anchor_mask")
+    cls_num = int(op.attr("class_num"))
+    ignore = op.attr("ignore_thresh", 0.7)
+    down = op.attr("downsample_ratio", 32)
+
+    n, c, h, w = x.shape
+    na = len(mask)
+    all_anchors = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    masked_anchors = all_anchors[jnp.asarray(mask)]
+    in_h, in_w = h * down, w * down
+    x = x.reshape(n, na, 5 + cls_num, h, w)
+    px, py = x[:, :, 0], x[:, :, 1]     # raw (pre-sigmoid)
+    pw, ph = x[:, :, 2], x[:, :, 3]
+    pobj = x[:, :, 4]
+    pcls = x[:, :, 5:]
+
+    def sce(logit, label):
+        return (jnp.maximum(logit, 0) - logit * label
+                + jnp.log1p(jnp.exp(-jnp.abs(logit))))
+
+    # decode predicted boxes (normalized cx cy w h) for the ignore mask
+    gi = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    gj = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    bx = (jax.nn.sigmoid(px) + gi) / w
+    by = (jax.nn.sigmoid(py) + gj) / h
+    bw = jnp.exp(pw) * masked_anchors[None, :, 0, None, None] / in_w
+    bh = jnp.exp(ph) * masked_anchors[None, :, 1, None, None] / in_h
+
+    nb = gt_box.shape[1]
+    valid_gt = (gt_box[..., 2] > 0) & (gt_box[..., 3] > 0)  # [N, B]
+
+    def cwh_iou(w1, h1, w2, h2):
+        inter = jnp.minimum(w1, w2) * jnp.minimum(h1, h2)
+        return inter / jnp.maximum(w1 * h1 + w2 * h2 - inter, 1e-10)
+
+    # gt -> best anchor over ALL anchors (scale ownership), then position
+    g_w, g_h = gt_box[..., 2], gt_box[..., 3]
+    iou_an = cwh_iou(g_w[..., None] * in_w, g_h[..., None] * in_h,
+                     all_anchors[None, None, :, 0],
+                     all_anchors[None, None, :, 1])  # [N, B, A_all]
+    best_anchor = jnp.argmax(iou_an, axis=-1)  # [N, B]
+    # position of the responsible cell
+    cell_i = jnp.clip((gt_box[..., 0] * w).astype(jnp.int32), 0, w - 1)
+    cell_j = jnp.clip((gt_box[..., 1] * h).astype(jnp.int32), 0, h - 1)
+
+    mask_arr = jnp.asarray(mask)
+    loss = jnp.zeros((n,), jnp.float32)
+    # objectness ignore mask: pred boxes with IoU>thresh vs any gt
+    pred_cwh = jnp.stack([bx, by, bw, bh], axis=-1)  # [N,na,h,w,4]
+
+    def box_iou_cwh(p, g):
+        # p [..., 4], g [..., 4] (cx cy w h)
+        px1, py1 = p[..., 0] - p[..., 2] / 2, p[..., 1] - p[..., 3] / 2
+        px2, py2 = p[..., 0] + p[..., 2] / 2, p[..., 1] + p[..., 3] / 2
+        gx1, gy1 = g[..., 0] - g[..., 2] / 2, g[..., 1] - g[..., 3] / 2
+        gx2, gy2 = g[..., 0] + g[..., 2] / 2, g[..., 1] + g[..., 3] / 2
+        iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+        ihh = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+        inter = iw * ihh
+        ua = (p[..., 2] * p[..., 3] + g[..., 2] * g[..., 3] - inter)
+        return inter / jnp.maximum(ua, 1e-10)
+
+    ious = box_iou_cwh(pred_cwh[:, :, :, :, None, :],
+                       gt_box[:, None, None, None, :, :])  # [N,na,h,w,B]
+    ious = jnp.where(valid_gt[:, None, None, None, :], ious, 0.0)
+    noobj_ok = jnp.max(ious, axis=-1) <= ignore  # [N, na, h, w]
+
+    # objectness target: 1 at the responsible (anchor, cell) of each gt.
+    # Scatter with SET semantics (one gt wins a contested cell, matching
+    # the reference's overwrite) via a flat index with a dump slot for
+    # off-scale gts — add-semantics would sum colliding targets.
+    bidx = jnp.arange(n)[:, None].repeat(nb, 1)
+    # map best (global) anchor -> local mask slot; -1 if not on this scale
+    local = jnp.argmax(
+        (mask_arr[None, None, :] == best_anchor[..., None])
+        .astype(jnp.int32), axis=-1)
+    on_scale = jnp.any(mask_arr[None, None, :] == best_anchor[..., None],
+                       axis=-1) & valid_gt
+    sel_anchor = jnp.where(on_scale, local, 0)
+    scale = 2.0 - g_w * g_h  # big boxes weigh less (ref loss_weight)
+    cells = na * h * w
+    fidx = jnp.where(on_scale,
+                     sel_anchor * (h * w) + cell_j * w + cell_i, cells)
+
+    def upd(v):
+        t = jnp.zeros((n, cells + 1)).at[bidx, fidx].set(v)
+        return t[:, :cells].reshape(n, na, h, w)
+
+    obj_t = upd(jnp.ones_like(scale))
+    tx = upd(gt_box[..., 0] * w - cell_i)
+    ty = upd(gt_box[..., 1] * h - cell_j)
+    anchor_w = masked_anchors[sel_anchor, 0]
+    anchor_h = masked_anchors[sel_anchor, 1]
+    tw = upd(jnp.log(jnp.maximum(g_w * in_w, 1e-9) / anchor_w))
+    th = upd(jnp.log(jnp.maximum(g_h * in_h, 1e-9) / anchor_h))
+    tscale = upd(scale)
+    cls_onehot = jax.nn.one_hot(gt_label.astype(jnp.int32), cls_num)
+    tcls = (jnp.zeros((n, cells + 1, cls_num))
+            .at[bidx, fidx].set(cls_onehot)[:, :cells]
+            .reshape(n, na, h, w, cls_num))
+
+    pos = obj_t > 0
+    per = (tscale * (sce(px, tx) + sce(py, ty)) * pos
+           + tscale * 0.5 * ((pw - tw) ** 2 + (ph - th) ** 2) * pos)
+    obj_loss = sce(pobj, obj_t) * jnp.where(pos, 1.0, noobj_ok)
+    cls_loss = jnp.sum(
+        sce(pcls, tcls.transpose(0, 1, 4, 2, 3)), axis=2) * pos
+    total = jnp.sum(per + obj_loss + cls_loss, axis=(1, 2, 3))
+    put(env, op.output("Loss"), total)
+
+
+# ---------------------------------------------------------------------------
+# ssd_loss helper ops (the layer composes these; ref layers/detection.py
+# ssd_loss builds the same steps from reshape/gather primitives over LoD)
+# ---------------------------------------------------------------------------
+
+@register("batched_iou_similarity")
+def _batched_iou(env, op):
+    x = get(env, op.input("X"))  # [N, M, 4]
+    y = get(env, op.input("Y"))  # [P, 4]
+    put(env, op.output("Out"),
+        _iou_matrix(x, jnp.broadcast_to(y, (x.shape[0],) + y.shape)))
+
+
+@register("ssd_encode_matched")
+def _ssd_encode_matched(env, op):
+    """Per-prior regression target: encode the MATCHED gt box against each
+    prior (unmatched priors get zeros)."""
+    gt = get(env, op.input("GTBox"))           # [N, B, 4] corners
+    match = get(env, op.input("MatchIndices"))  # [N, P]
+    prior = get(env, op.input("PriorBox"))     # [P, 4]
+    pvar = get(env, op.input("PriorBoxVar"))
+    if pvar is None:
+        pvar = jnp.asarray([0.1, 0.1, 0.2, 0.2], prior.dtype)
+    safe = jnp.maximum(match, 0)
+    g = jnp.take_along_axis(gt, safe[..., None].astype(jnp.int32), axis=1)
+    pw = prior[:, 2] - prior[:, 0]
+    ph = prior[:, 3] - prior[:, 1]
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    gw = g[..., 2] - g[..., 0]
+    gh = g[..., 3] - g[..., 1]
+    gcx = g[..., 0] + gw * 0.5
+    gcy = g[..., 1] + gh * 0.5
+    v = pvar.reshape(-1, 4) if pvar.ndim == 2 else pvar.reshape(1, 4)
+    ex = (gcx - pcx[None]) / pw[None] / v[..., 0]
+    ey = (gcy - pcy[None]) / ph[None] / v[..., 1]
+    ew = jnp.log(jnp.maximum(gw, 1e-8) / pw[None]) / v[..., 2]
+    eh = jnp.log(jnp.maximum(gh, 1e-8) / ph[None]) / v[..., 3]
+    enc = jnp.stack([ex, ey, ew, eh], axis=-1)
+    put(env, op.output("Out"),
+        jnp.where((match >= 0)[..., None], enc, 0.0))
+
+
+@register("ssd_gather_labels")
+def _ssd_gather_labels(env, op):
+    gt_label = get(env, op.input("GTLabel"))   # [N, B] or [N, B, 1]
+    match = get(env, op.input("MatchIndices"))  # [N, P]
+    bg = op.attr("background_label", 0)
+    if gt_label.ndim == 3:
+        gt_label = gt_label[..., 0]
+    safe = jnp.maximum(match, 0)
+    g = jnp.take_along_axis(gt_label, safe.astype(jnp.int32), axis=1)
+    put(env, op.output("Out"),
+        jnp.where(match >= 0, g, bg).astype(jnp.int32))
+
+
+@register("ssd_mining_masks")
+def _ssd_mining_masks(env, op):
+    mined = get(env, op.input("Mined"))  # [N, P]: gt idx / -1 kept neg / -2
+    put(env, op.output("Selected"), (mined >= -1).astype(jnp.float32))
+    put(env, op.output("Positive"), (mined >= 0).astype(jnp.float32))
+
+
+@register("ssd_smooth_l1")
+def _ssd_smooth_l1(env, op):
+    """Per-prior smooth-L1 over the coordinate axis: [N, P, 4] -> [N, P]
+    (the reference's ssd_loss sums smooth-L1 per prior before weighting)."""
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    d = jnp.abs(x - y)
+    per = jnp.where(d < 1.0, 0.5 * d * d, d - 0.5)
+    put(env, op.output("Out"), jnp.sum(per, axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# Faster R-CNN training-path ops
+# ---------------------------------------------------------------------------
+
+def _rank_pos(key):
+    """rank_pos[i] = position of i in ascending-key order."""
+    n = key.shape[0]
+    return jnp.zeros((n,), jnp.int32).at[jnp.argsort(key)].set(
+        jnp.arange(n, dtype=jnp.int32))
+
+
+def _encode_center_size(ref_boxes, matched, one=1.0):
+    """Encode matched gt against reference boxes (pixel +1 convention;
+    the normalized/variance-scaled variants live in _box_coder and
+    _ssd_encode_matched). Degenerate matches (padded zero-area gt rows
+    that scored IoU 0 and are masked out downstream) are clamped so the
+    log never produces -inf into the masked lanes."""
+    rw = jnp.maximum(ref_boxes[:, 2] - ref_boxes[:, 0] + one, 1e-6)
+    rh = jnp.maximum(ref_boxes[:, 3] - ref_boxes[:, 1] + one, 1e-6)
+    rcx = ref_boxes[:, 0] + rw * 0.5
+    rcy = ref_boxes[:, 1] + rh * 0.5
+    gw = jnp.maximum(matched[:, 2] - matched[:, 0] + one, 1e-6)
+    gh = jnp.maximum(matched[:, 3] - matched[:, 1] + one, 1e-6)
+    gcx = matched[:, 0] + gw * 0.5
+    gcy = matched[:, 1] + gh * 0.5
+    return jnp.stack([(gcx - rcx) / rw, (gcy - rcy) / rh,
+                      jnp.log(gw / rw), jnp.log(gh / rh)], axis=1)
+
+
+@register("rpn_target_assign")
+def _rpn_target_assign(env, op):
+    """Ref ``rpn_target_assign_op.cc``: label anchors fg/bg by IoU and
+    emit regression targets.
+
+    Fixed-shape re-design: instead of emitting variable-length index
+    lists, outputs are per-anchor [N, A]: ScoreLabel (1 fg / 0 bg /
+    -1 ignore) and LocTarget [N, A, 4] (encoded gt for fg anchors).
+    Sampling quotas use score-ranked deterministic selection (XLA has no
+    cheap random subset; documented deviation from the reference's random
+    sampling — same quotas, deterministic choice)."""
+    anchors = get(env, op.input("Anchor")).reshape(-1, 4)  # [A, 4]
+    gt = get(env, op.input("GtBoxes"))                     # [N, G, 4]
+    n, g, _ = gt.shape
+    a = anchors.shape[0]
+    pos_thresh = op.attr("rpn_positive_overlap", 0.7)
+    neg_thresh = op.attr("rpn_negative_overlap", 0.3)
+    batch_per_im = int(op.attr("rpn_batch_size_per_im", 256))
+    fg_frac = op.attr("rpn_fg_fraction", 0.5)
+
+    valid_gt = (gt[..., 2] > gt[..., 0]) & (gt[..., 3] > gt[..., 1])
+
+    def one(gt_i, valid_i):
+        # pixel (+1) convention for BOTH the IoU and the encode, so the
+        # matching thresholds and regression targets agree
+        iou = _iou_matrix(anchors, gt_i, norm=False)  # [A, G]
+        iou = jnp.where(valid_i[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        best_gt = jnp.argmax(iou, axis=1)
+        # fg: above threshold, or the argmax anchor of each VALID gt
+        # (scatter-max: padded gt rows must not overwrite a True)
+        fg = best >= pos_thresh
+        gt_best_anchor = jnp.argmax(iou, axis=0)  # [G]
+        forced = jnp.zeros((a,), bool).at[gt_best_anchor].max(valid_i)
+        fg = fg | forced
+        bg = (best < neg_thresh) & ~fg
+        # quotas: top fg by IoU, top bg by (inverse) IoU
+        max_fg = int(batch_per_im * fg_frac)
+        fg_keep = fg & (_rank_pos(jnp.where(fg, -best, jnp.inf)) < max_fg)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        max_bg = batch_per_im - n_fg
+        bg_keep = bg & (_rank_pos(jnp.where(bg, best, jnp.inf)) < max_bg)
+        label = jnp.where(fg_keep, 1, jnp.where(bg_keep, 0, -1))
+        tgt = _encode_center_size(anchors, gt_i[best_gt])
+        tgt = jnp.where(fg_keep[:, None], tgt, 0.0)
+        return label.astype(jnp.int32), tgt
+
+    labels, tgts = jax.vmap(one)(gt, valid_gt)
+    put(env, op.output("ScoreLabel"), labels)
+    put(env, op.output("LocTarget"), tgts)
+
+
+@register("generate_proposal_labels")
+def _generate_proposal_labels(env, op):
+    """Ref ``generate_proposal_labels_op.cc``: sample RoIs into fg/bg for
+    the second stage and build per-class regression targets.
+
+    Fixed-shape re-design: RoIs stay [N, R, 4]; outputs are per-roi
+    LabelsInt32 [N, R] (class id, 0 = background, -1 = unsampled),
+    BboxTargets [N, R, 4] (fg rows encoded vs matched gt), and the
+    fg/bg InsideWeights mask. Deterministic IoU-ranked sampling."""
+    rois = get(env, op.input("RpnRois"))      # [N, R, 4]
+    gt_cls = get(env, op.input("GtClasses")).astype(jnp.int32)  # [N, G]
+    gt_box = get(env, op.input("GtBoxes"))    # [N, G, 4]
+    bs_per_im = int(op.attr("batch_size_per_im", 128))
+    fg_frac = op.attr("fg_fraction", 0.25)
+    fg_thresh = op.attr("fg_thresh", 0.5)
+    bg_hi = op.attr("bg_thresh_hi", 0.5)
+    bg_lo = op.attr("bg_thresh_lo", 0.0)
+    n, r, _ = rois.shape
+
+    valid_gt = (gt_box[..., 2] > gt_box[..., 0]) \
+        & (gt_box[..., 3] > gt_box[..., 1])
+
+    def one(rois_i, gt_i, cls_i, vgt):
+        iou = _iou_matrix(rois_i, gt_i, norm=False)
+        iou = jnp.where(vgt[None, :], iou, 0.0)
+        best = jnp.max(iou, axis=1)
+        bidx = jnp.argmax(iou, axis=1)
+        fg = best >= fg_thresh
+        bg = (best < bg_hi) & (best >= bg_lo)
+        max_fg = int(bs_per_im * fg_frac)
+        fg_keep = fg & (_rank_pos(jnp.where(fg, -best, jnp.inf)) < max_fg)
+        n_fg = jnp.sum(fg_keep.astype(jnp.int32))
+        bg_keep = bg & (_rank_pos(jnp.where(bg, best, jnp.inf))
+                        < (bs_per_im - n_fg))
+        label = jnp.where(fg_keep, cls_i[bidx],
+                          jnp.where(bg_keep, 0, -1))
+        tgt = _encode_center_size(rois_i, gt_i[bidx])
+        tgt = jnp.where(fg_keep[:, None], tgt, 0.0)
+        return label.astype(jnp.int32), tgt, \
+            fg_keep.astype(jnp.float32)[:, None]
+
+    labels, tgts, w = jax.vmap(one)(rois, gt_box, gt_cls, valid_gt)
+    put(env, op.output("LabelsInt32"), labels)
+    put(env, op.output("BboxTargets"), tgts)
+    put(env, op.output("BboxInsideWeights"), w)
+
+
+@register("roi_perspective_transform")
+def _roi_perspective_transform(env, op):
+    """Ref ``roi_perspective_transform_op.cc``: warp quadrilateral ROIs to
+    a fixed rectangle by the perspective transform, bilinear-sampled
+    (batch-0 rois, the repo ROI convention)."""
+    x = get(env, op.input("X"))          # [N, C, H, W]
+    rois = get(env, op.input("ROIs"))    # [R, 8] quad corners
+    oh = op.attr("transformed_height")
+    ow = op.attr("transformed_width")
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def solve_h(quad):
+        # map unit rect corners -> quad (projective); standard 8x8 solve
+        src = jnp.asarray([[0.0, 0], [ow - 1, 0], [ow - 1, oh - 1],
+                           [0, oh - 1]])
+        dst = quad.reshape(4, 2) * scale
+        rows = []
+        for i in range(4):
+            sx, sy = src[i, 0], src[i, 1]
+            dx, dy = dst[i, 0], dst[i, 1]
+            rows.append(jnp.asarray(
+                [sx, sy, 1, 0, 0, 0, 0, 0]).at[6].set(-dx * sx)
+                .at[7].set(-dx * sy))
+            rows.append(jnp.asarray(
+                [0, 0, 0, sx, sy, 1, 0, 0]).at[6].set(-dy * sx)
+                .at[7].set(-dy * sy))
+        A = jnp.stack(rows)
+        b = dst.reshape(-1)
+        hvec = jnp.linalg.solve(A, b)
+        return jnp.concatenate([hvec, jnp.ones((1,))]).reshape(3, 3)
+
+    def one(quad):
+        hm = solve_h(quad)
+        ys, xs = jnp.meshgrid(jnp.arange(oh, dtype=jnp.float32),
+                              jnp.arange(ow, dtype=jnp.float32),
+                              indexing="ij")
+        ones = jnp.ones_like(xs)
+        pts = jnp.stack([xs, ys, ones], axis=-1) @ hm.T
+        px = pts[..., 0] / jnp.maximum(pts[..., 2], 1e-8)
+        py = pts[..., 1] / jnp.maximum(pts[..., 2], 1e-8)
+        x0 = jnp.clip(jnp.floor(px).astype(jnp.int32), 0, w - 1)
+        y0 = jnp.clip(jnp.floor(py).astype(jnp.int32), 0, h - 1)
+        x1 = jnp.clip(x0 + 1, 0, w - 1)
+        y1 = jnp.clip(y0 + 1, 0, h - 1)
+        wx = px - x0
+        wy = py - y0
+        img = x[0]
+        out = (img[:, y0, x0] * (1 - wy) * (1 - wx)
+               + img[:, y1, x0] * wy * (1 - wx)
+               + img[:, y0, x1] * (1 - wy) * wx
+               + img[:, y1, x1] * wy * wx)
+        inside = ((px >= 0) & (px <= w - 1) & (py >= 0) & (py <= h - 1))
+        return out * inside[None].astype(out.dtype)
+
+    put(env, op.output("Out"), jax.vmap(one)(rois))
+
+
+def _point_in_polys(polys, px, py):
+    """Even-odd rasterization: ``polys`` [P, V, 2] (degenerate repeated-
+    point padding contributes nothing), ``px``/``py`` [M, M] sample
+    points. Returns bool [M, M] — inside the union of the P polygons."""
+    v1 = polys                      # [P, V, 2]
+    v2 = jnp.roll(polys, -1, axis=1)
+    x1 = v1[..., 0][:, :, None, None]
+    y1 = v1[..., 1][:, :, None, None]
+    x2 = v2[..., 0][:, :, None, None]
+    y2 = v2[..., 1][:, :, None, None]
+    pxb = px[None, None]
+    pyb = py[None, None]
+    straddles = (y1 <= pyb) != (y2 <= pyb)
+    # x coordinate where the edge crosses the horizontal line through py
+    t = (pyb - y1) / jnp.where(y2 == y1, 1.0, y2 - y1)
+    cross_x = x1 + t * (x2 - x1)
+    crossings = jnp.sum((straddles & (pxb < cross_x)).astype(jnp.int32),
+                       axis=1)  # [P, M, M]
+    return jnp.any(crossings % 2 == 1, axis=0)
+
+
+@register("generate_mask_labels")
+def _generate_mask_labels(env, op):
+    """Ref ``detection/generate_mask_labels_op.cc`` (+ ``mask_util.cc``
+    Polys2MaskWrtBox): associate each foreground RoI with the gt mask of
+    highest bbox overlap and rasterize its polygons into a class-specific
+    [resolution, resolution] target.
+
+    Fixed-shape re-design (the reference kernel is CPU-pinned and
+    LoD-variadic): GtSegms is [N, G, P, V, 2] with degenerate repeated-
+    point padding; outputs keep the RoI axis — MaskRois [N, R, 4],
+    RoiHasMaskInt32 [N, R] (1 = fg row carries a target, the redesign of
+    the reference's fg index list), MaskInt32 [N, R, C*M*M] with -1
+    ignore labels outside each fg row's class segment. Rasterization is
+    even-odd point-in-polygon at pixel centers (subpixel boundary
+    handling may differ from the reference's RLE scanline by <=1px)."""
+    im_info = get(env, op.input("ImInfo"))                  # [N, 3]
+    gt_cls = get(env, op.input("GtClasses")).astype(jnp.int32)   # [N, G]
+    is_crowd = get(env, op.input("IsCrowd")).astype(jnp.int32)   # [N, G]
+    segms = get(env, op.input("GtSegms")).astype(jnp.float32)  # [N,G,P,V,2]
+    rois = get(env, op.input("Rois"))                       # [N, R, 4]
+    labels = get(env, op.input("LabelsInt32")).astype(jnp.int32)  # [N, R]
+    num_classes = int(op.attr("num_classes"))
+    m = int(op.attr("resolution"))
+
+    def one(info, cls_i, crowd_i, segms_i, rois_i, lab_i):
+        scale = info[2]
+        valid_gt = (cls_i > 0) & (crowd_i == 0)
+        pts = segms_i.reshape(segms_i.shape[0], -1, 2)      # [G, P*V, 2]
+        gx1 = jnp.min(pts[..., 0], axis=1)
+        gy1 = jnp.min(pts[..., 1], axis=1)
+        gx2 = jnp.max(pts[..., 0], axis=1)
+        gy2 = jnp.max(pts[..., 1], axis=1)
+        poly_boxes = jnp.stack([gx1, gy1, gx2, gy2], axis=1)  # [G, 4]
+
+        fg = lab_i > 0
+        rois_im = rois_i / jnp.maximum(scale, 1e-8)  # image coords
+        iou = _iou_matrix(rois_im, poly_boxes, norm=False)
+        iou = jnp.where(valid_gt[None, :], iou, -1.0)
+        match = jnp.argmax(iou, axis=1)              # [R]
+
+        jj, ii = jnp.meshgrid(jnp.arange(m), jnp.arange(m), indexing="xy")
+
+        def rasterize(roi, gt_idx):
+            x1, y1, x2, y2 = roi[0], roi[1], roi[2], roi[3]
+            w = jnp.maximum(x2 - x1, 1.0)
+            h = jnp.maximum(y2 - y1, 1.0)
+            polys = segms_i[gt_idx]                  # [P, V, 2]
+            # transform polygons into the M-grid of the roi box
+            tx = (polys[..., 0] - x1) * m / w
+            ty = (polys[..., 1] - y1) * m / h
+            tp = jnp.stack([tx, ty], axis=-1)
+            return _point_in_polys(tp, jj + 0.5, ii + 0.5)
+
+        masks = jax.vmap(rasterize)(rois_im, match)  # [R, m, m] bool
+        mask_flat = masks.reshape(rois_i.shape[0], m * m).astype(jnp.int32)
+
+        # expand to class-specific segments, -1 = ignore
+        seg_ids = jnp.arange(num_classes * m * m) // (m * m)  # [C*M*M]
+        expanded = jnp.where(
+            fg[:, None] & (seg_ids[None, :] == lab_i[:, None]),
+            jnp.tile(mask_flat, (1, num_classes)),
+            -1)
+        mask_rois = jnp.where(fg[:, None], rois_i, 0.0)
+        return mask_rois, fg.astype(jnp.int32), expanded
+
+    mask_rois, has_mask, mask_int = jax.vmap(one)(
+        im_info, gt_cls, is_crowd, segms, rois, labels)
+    put(env, op.output("MaskRois"), mask_rois)
+    put(env, op.output("RoiHasMaskInt32"), has_mask)
+    put(env, op.output("MaskInt32"), mask_int)

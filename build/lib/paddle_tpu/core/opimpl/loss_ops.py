@@ -1,0 +1,237 @@
+"""CTC / CRF / NCE / hierarchical-sigmoid losses.
+
+Reference kernels: ``operators/warpctc_op.cc`` (wraps the warp-ctc lib),
+``operators/linear_chain_crf_op.cc`` + ``crf_decoding_op.cc``,
+``operators/nce_op.cc``, ``operators/hierarchical_sigmoid_op.cc`` (+
+``math/matrix_bit_code.h`` SimpleCode tree). TPU-native: the dynamic-
+programming recursions (CTC alpha, CRF forward, Viterbi) are ``lax.scan``
+over time in log space on padded [B, T, ...] batches with length masks —
+no LoD, no external warp-ctc; gradients come from jax autodiff through the
+scan instead of hand-written backward kernels.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put, next_rng
+
+_NEG = -1e30
+
+
+def _lengths(env, op, slot, batch, default):
+    v = op.input(slot)
+    if v is None:
+        return jnp.full((batch,), default, jnp.int32)
+    return env[v.name].reshape(batch).astype(jnp.int32)
+
+
+@register("warpctc")
+def _warpctc(env, op):
+    """CTC loss on padded [B, T, C] logits (softmax applied internally,
+    matching warp-ctc). Alpha recursion over the blank-extended label
+    sequence [blank, y1, blank, ..., yL, blank] in log space."""
+    logits = get(env, op.input("Logits"))
+    label = get(env, op.input("Label")).astype(jnp.int32)
+    b, t_max, _ = logits.shape
+    l_max = label.shape[1]
+    in_len = _lengths(env, op, "LogitsLength", b, t_max)
+    lb_len = _lengths(env, op, "LabelLength", b, l_max)
+    blank = op.attr("blank", 0)
+
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    s = 2 * l_max + 1
+    ext = jnp.full((b, s), blank, jnp.int32).at[:, 1::2].set(label)
+    # position s may receive from s-2 when it is a non-blank that differs
+    # from the non-blank two slots back (standard CTC transition rule)
+    allow = ((jnp.arange(s) >= 2) & (ext != blank)
+             & (ext != jnp.roll(ext, 2, axis=1)))
+
+    lp0 = jnp.take_along_axis(logp[:, 0, :], ext, axis=1)
+    alpha0 = jnp.full((b, s), _NEG)
+    alpha0 = alpha0.at[:, 0].set(lp0[:, 0])
+    alpha0 = alpha0.at[:, 1].set(lp0[:, 1])
+
+    def step(alpha, t):
+        lp = jnp.take_along_axis(logp[:, t, :], ext, axis=1)
+        s1 = jnp.concatenate(
+            [jnp.full((b, 1), _NEG), alpha[:, :-1]], axis=1)
+        s2 = jnp.concatenate(
+            [jnp.full((b, 2), _NEG), alpha[:, :-2]], axis=1)
+        s2 = jnp.where(allow, s2, _NEG)
+        stacked = jnp.stack([alpha, s1, s2])
+        new = jax.scipy.special.logsumexp(stacked, axis=0) + lp
+        new = jnp.where((t < in_len)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+    end_b = jnp.take_along_axis(alpha, (2 * lb_len)[:, None], 1)[:, 0]
+    end_l = jnp.take_along_axis(
+        alpha, jnp.maximum(2 * lb_len - 1, 0)[:, None], 1)[:, 0]
+    # empty label: the only path is all-blank, ending at position 0 — the
+    # clamped second readout would double-count it
+    end_l = jnp.where(lb_len > 0, end_l, _NEG)
+    nll = -jnp.logaddexp(end_b, end_l)
+    if op.attr("norm_by_times", False):
+        # reference warpctc_op scales only the GRADIENT by 1/T; the Loss
+        # output stays un-normalized
+        scaled = nll / in_len.astype(nll.dtype)
+        nll = scaled + jax.lax.stop_gradient(nll - scaled)
+    put(env, op.output("Loss"), nll[:, None])
+
+
+def _crf_unpack(transition):
+    """Fluid transition layout (``linear_chain_crf_op.h``): row 0 = start
+    weights, row 1 = end weights, rows 2.. = square transition matrix."""
+    return transition[0], transition[1], transition[2:]
+
+
+@register("linear_chain_crf")
+def _linear_chain_crf(env, op):
+    emission = get(env, op.input("Emission"))   # [B, T, D]
+    transition = get(env, op.input("Transition"))
+    label = get(env, op.input("Label")).astype(jnp.int32)  # [B, T]
+    b, t_max, _ = emission.shape
+    length = _lengths(env, op, "Length", b, t_max)
+    start, end, w = _crf_unpack(transition)
+    mask = jnp.arange(t_max)[None, :] < length[:, None]
+
+    # gold path score
+    e_gold = jnp.take_along_axis(emission, label[..., None], 2)[..., 0]
+    e_sum = jnp.sum(e_gold * mask, axis=1)
+    trans_pairs = w[label[:, :-1], label[:, 1:]]       # [B, T-1]
+    t_sum = jnp.sum(trans_pairs * mask[:, 1:], axis=1)
+    last_lbl = jnp.take_along_axis(
+        label, jnp.maximum(length - 1, 0)[:, None], 1)[:, 0]
+    gold = start[label[:, 0]] + e_sum + t_sum + end[last_lbl]
+
+    # partition function (forward algorithm)
+    alpha0 = start[None, :] + emission[:, 0, :]
+
+    def step(alpha, t):
+        new = jax.scipy.special.logsumexp(
+            alpha[:, :, None] + w[None], axis=1) + emission[:, t]
+        new = jnp.where((t < length)[:, None], new, alpha)
+        return new, None
+
+    alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+    log_z = jax.scipy.special.logsumexp(alpha + end[None], axis=1)
+    put(env, op.output("LogLikelihood"), (log_z - gold)[:, None])
+
+
+@register("crf_decoding")
+def _crf_decoding(env, op):
+    """Viterbi decode (ref ``crf_decoding_op.h``); with a Label input the
+    output is the per-position correctness mask (reference semantics)."""
+    emission = get(env, op.input("Emission"))
+    transition = get(env, op.input("Transition"))
+    b, t_max, d = emission.shape
+    length = _lengths(env, op, "Length", b, t_max)
+    start, end, w = _crf_unpack(transition)
+
+    v0 = start[None, :] + emission[:, 0, :]
+
+    def fwd(v, t):
+        scores = v[:, :, None] + w[None]              # [B, D, D]
+        best_prev = jnp.argmax(scores, axis=1)        # [B, D]
+        new = jnp.max(scores, axis=1) + emission[:, t]
+        live = (t < length)[:, None]
+        new = jnp.where(live, new, v)
+        best_prev = jnp.where(
+            live, best_prev, jnp.arange(d)[None, :])  # identity pass-through
+        return new, best_prev
+
+    v_t, back = jax.lax.scan(fwd, v0, jnp.arange(1, t_max))
+    last = jnp.argmax(v_t + end[None], axis=1)        # [B]
+
+    def bwd(idx, bp):
+        prev = jnp.take_along_axis(bp, idx[:, None], 1)[:, 0]
+        return prev, idx
+
+    s0, path_rev = jax.lax.scan(bwd, last, back[::-1])
+    # path_rev emits states T-1..1; the final carry is the t=0 state
+    path = jnp.concatenate(
+        [s0[None], jnp.flip(path_rev, axis=0)], axis=0).T  # [B, T]
+    live = jnp.arange(t_max)[None] < length[:, None]
+    path = jnp.where(live, path, 0)
+    lbl_var = op.input("Label")
+    if lbl_var is not None:
+        lbl = env[lbl_var.name].astype(path.dtype)
+        out = ((path == lbl) & live).astype(jnp.int64)
+    else:
+        out = path.astype(jnp.int64)
+    put(env, op.output("ViterbiPath"), out)
+
+
+def _log_q(sampler, ids, vocab):
+    if sampler == "log_uniform":
+        idf = ids.astype(jnp.float32)
+        return jnp.log(
+            (jnp.log(idf + 2.0) - jnp.log(idf + 1.0))
+            / jnp.log(vocab + 1.0))
+    return jnp.full(ids.shape, -jnp.log(float(vocab)))
+
+
+@register("nce")
+def _nce(env, op):
+    """Noise-contrastive estimation (ref ``nce_op.h``): logistic loss on the
+    true class vs ``num_neg_samples`` sampled noise classes, scores shifted
+    by log(k·q(class))."""
+    x = get(env, op.input("Input"))                 # [B, D]
+    label = get(env, op.input("Label")).reshape(x.shape[0]).astype(jnp.int32)
+    w = get(env, op.input("Weight"))                # [V, D]
+    bias = get(env, op.input("Bias"))               # [V] or None
+    k = op.attr("num_neg_samples")
+    sampler = op.attr("sampler", "uniform")
+    vocab = w.shape[0]
+    seed = op.attr("seed", 0)
+    key = jax.random.PRNGKey(seed) if seed else next_rng(env)
+    if sampler == "log_uniform":
+        u = jax.random.uniform(key, (x.shape[0], k))
+        neg = (jnp.exp(u * jnp.log(vocab + 1.0)) - 1.0).astype(jnp.int32)
+        neg = jnp.clip(neg, 0, vocab - 1)
+    else:
+        neg = jax.random.randint(key, (x.shape[0], k), 0, vocab)
+
+    def score(ids):
+        s = jnp.sum(jnp.take(w, ids, axis=0) * x[:, None, :], axis=-1)
+        if bias is not None:
+            s = s + jnp.take(bias.reshape(-1), ids)
+        return s
+
+    log_kq_pos = jnp.log(float(k)) + _log_q(sampler, label[:, None], vocab)
+    log_kq_neg = jnp.log(float(k)) + _log_q(sampler, neg, vocab)
+    s_true = score(label[:, None]) - log_kq_pos     # [B, 1]
+    s_neg = score(neg) - log_kq_neg                 # [B, k]
+    cost = (jax.nn.softplus(-s_true)[:, 0]
+            + jnp.sum(jax.nn.softplus(s_neg), axis=1))
+    put(env, op.output("Cost"), cost[:, None])
+
+
+@register("hsigmoid")
+def _hsigmoid(env, op):
+    """Hierarchical sigmoid over a class tree (ref
+    ``hierarchical_sigmoid_op.h`` + ``math/matrix_bit_code.h``): per-class
+    (node indices, bit codes) arrive as static attrs (default complete
+    binary tree) or as PathTable/PathCode inputs (custom tree)."""
+    x = get(env, op.input("Input"))                 # [B, D]
+    label = get(env, op.input("Label")).reshape(x.shape[0]).astype(jnp.int32)
+    w = get(env, op.input("W"))                     # [nodes, D]
+    bias = get(env, op.input("Bias"))
+    pt_var = op.input("PathTable")
+    if pt_var is not None:
+        idx = env[pt_var.name].astype(jnp.int32)
+        bits = env[op.input("PathCode").name].astype(jnp.float32)
+    else:
+        table = jnp.asarray(op.attr("path_table"), jnp.int32)   # [C, Lmax]
+        codes = jnp.asarray(op.attr("path_code"), jnp.float32)
+        idx = jnp.take(table, label, axis=0)        # [B, Lmax]
+        bits = jnp.take(codes, label, axis=0)
+    valid = idx >= 0
+    safe = jnp.maximum(idx, 0)
+    pre = jnp.sum(jnp.take(w, safe, axis=0) * x[:, None, :], axis=-1)
+    if bias is not None:
+        pre = pre + jnp.take(bias.reshape(-1), safe)
+    # sigmoid cross entropy with target = bit
+    bit_loss = jax.nn.softplus(pre) - bits * pre
+    cost = jnp.sum(bit_loss * valid, axis=1)
+    put(env, op.output("Cost"), cost[:, None])

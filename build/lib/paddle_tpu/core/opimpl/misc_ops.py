@@ -1,0 +1,903 @@
+"""Long-tail op coverage: metrics, losses, image/feature ops, sequence
+utilities (ref ``paddle/fluid/operators/*_op.cc`` — one kernel trio each
+there; one jnp function each here).
+
+Conventions: padded [B, ...] batches; ops that are LoD-shaped in the
+reference take explicit length inputs; dynamic-size outputs are padded
+with a validity count where needed (XLA static shapes).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..op_registry import register, get, put, next_rng
+
+
+# ---------------- losses ----------------
+
+@register("rank_loss")
+def _rank_loss(env, op):
+    """Ref ``rank_loss_op.cc``: RankNet pairwise loss."""
+    label = get(env, op.input("Label"))
+    left = get(env, op.input("Left"))
+    right = get(env, op.input("Right"))
+    d = left - right
+    put(env, op.output("Out"),
+        jnp.log1p(jnp.exp(d)) - label * d)
+
+
+@register("modified_huber_loss")
+def _modified_huber(env, op):
+    """Ref ``modified_huber_loss_op.cc``: y in {0,1} -> {-1,1}."""
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y")) * 2.0 - 1.0
+    z = x * y
+    loss = jnp.where(z < -1.0, -4.0 * z,
+                     jnp.square(jnp.maximum(1.0 - z, 0.0)))
+    put(env, op.output("Out"), loss)
+
+
+@register("squared_l2_distance")
+def _squared_l2_distance(env, op):
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    sub = x - y
+    put(env, op.output("sub_result"), sub)
+    out = jnp.sum(jnp.square(sub).reshape(sub.shape[0], -1), axis=1,
+                  keepdims=True)
+    put(env, op.output("Out"), out)
+
+
+@register("l1_norm")
+def _l1_norm(env, op):
+    put(env, op.output("Out"),
+        jnp.sum(jnp.abs(get(env, op.input("X")))).reshape(()))
+
+
+@register("teacher_student_sigmoid_loss")
+def _teacher_student_loss(env, op):
+    """Ref ``teacher_student_sigmoid_loss_op.cc`` (CTR distillation)."""
+    x = get(env, op.input("X")).reshape(-1)
+    label = get(env, op.input("Label")).reshape(-1)
+    soft_max_up = op.attr("soft_max_up_bound", 15.0)
+    soft_max_lo = op.attr("soft_max_lower_bound", -15.0)
+    z = jnp.clip(x, soft_max_lo, soft_max_up)
+    # teacher part (label in (0,1)): sigmoid CE with soft label; student
+    # part (label <=0 or >=1): hard sigmoid CE
+    hard = (label <= 0.0) | (label >= 1.0)
+    hard_lbl = (label > 0.0).astype(x.dtype)
+    ce = jnp.maximum(z, 0) - z * jnp.where(hard, hard_lbl, label) \
+        + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    put(env, op.output("Y"), ce.reshape(-1, 1))
+
+
+# ---------------- metrics ----------------
+
+@register("mean_iou")
+def _mean_iou(env, op):
+    """Ref ``mean_iou_op.cc``: mean intersection-over-union over classes."""
+    pred = get(env, op.input("Predictions")).reshape(-1).astype(jnp.int32)
+    label = get(env, op.input("Labels")).reshape(-1).astype(jnp.int32)
+    n = op.attr("num_classes")
+    inter = jnp.zeros((n,)).at[pred].add((pred == label).astype(jnp.float32))
+    pred_cnt = jnp.zeros((n,)).at[pred].add(1.0)
+    lbl_cnt = jnp.zeros((n,)).at[label].add(1.0)
+    # reference semantics: on a mismatch BOTH the predicted and the label
+    # class count a wrong, so correct + wrong covers the union
+    wrong = (pred_cnt - inter) + (lbl_cnt - inter)
+    correct = inter
+    # optional accumulation inputs (the reference's in-tensor pattern)
+    for slot, acc in (("InWrongs", "wrong"), ("InCorrects", "correct")):
+        for v in op.input_list(slot):
+            if acc == "wrong":
+                wrong = wrong + get(env, v).astype(jnp.float32)
+            else:
+                correct = correct + get(env, v).astype(jnp.float32)
+    union = correct + wrong
+    valid = union > 0
+    iou = jnp.where(valid, correct / jnp.maximum(union, 1.0), 0.0)
+    miou = jnp.sum(iou) / jnp.maximum(jnp.sum(valid.astype(jnp.float32)),
+                                      1.0)
+    put(env, op.output("OutMeanIou"), miou.reshape(()))
+    put(env, op.output("OutWrong"), wrong.astype(jnp.int32))
+    put(env, op.output("OutCorrect"), correct.astype(jnp.int32))
+
+
+@register("edit_distance")
+def _edit_distance(env, op):
+    """Ref ``edit_distance_op.cc``: Levenshtein over padded id sequences
+    with explicit lengths, scan-lowered DP over the hypothesis axis."""
+    hyp = get(env, op.input("Hyps")).astype(jnp.int32)      # [B, Th]
+    ref = get(env, op.input("Refs")).astype(jnp.int32)      # [B, Tr]
+    hyp_len = get(env, op.input("HypsLength")).reshape(-1).astype(jnp.int32)
+    ref_len = get(env, op.input("RefsLength")).reshape(-1).astype(jnp.int32)
+    norm = op.attr("normalized", False)
+    b, th = hyp.shape
+    tr = ref.shape[1]
+
+    def one(h, r, hl, rl):
+        row0 = jnp.arange(tr + 1, dtype=jnp.float32)
+
+        def step(prev_row, i):
+            # prev_row: distances for hyp prefix i; compute prefix i+1
+            ins = prev_row[0] + 1.0
+
+            def inner(carry, j):
+                left = carry
+                sub = prev_row[j] + (h[i] != r[j]).astype(jnp.float32)
+                dele = prev_row[j + 1] + 1.0
+                cur = jnp.minimum(jnp.minimum(left + 1.0, dele), sub)
+                return cur, cur
+
+            _, rest = jax.lax.scan(inner, ins, jnp.arange(tr))
+            new_row = jnp.concatenate([ins[None], rest])
+            # beyond hyp length the row stays frozen
+            return jnp.where(i < hl, new_row, prev_row), None
+
+        final, _ = jax.lax.scan(step, row0, jnp.arange(th))
+        d = final[rl]
+        if norm:
+            d = d / jnp.maximum(rl.astype(jnp.float32), 1.0)
+        return d
+
+    out = jax.vmap(one)(hyp, ref, hyp_len, ref_len)
+    put(env, op.output("Out"), out.reshape(b, 1))
+    put(env, op.output("SequenceNum"), jnp.asarray(b, jnp.int32))
+
+
+def _chunk_marks(tags, valid, scheme, num_types):
+    """Per-position (begin, end, type) flags for CoNLL-style chunking
+    (ref ``chunk_eval_op.h`` ChunkEvalKernel::IsChunkBegin/End).
+    ``tags`` [B, T]; type = tag // num_tag_types, other = out of range."""
+    n_tags = {"plain": 1, "IOB": 2, "IOE": 2, "IOBES": 4}[scheme]
+    typ = jnp.where((tags >= 0) & (tags < num_types * n_tags),
+                    tags // n_tags, -1)
+    typ = jnp.where(valid, typ, -1)
+    role = tags % n_tags
+    # neighbors (other beyond the edges)
+    prev_t = jnp.pad(typ[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    next_t = jnp.pad(typ[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+    prev_r = jnp.pad(role[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    next_r = jnp.pad(role[:, 1:], ((0, 0), (0, 1)), constant_values=-1)
+    in_chunk = typ >= 0
+    if scheme == "plain":
+        begin = in_chunk & (prev_t != typ)
+        end = in_chunk & (next_t != typ)
+    elif scheme == "IOB":  # 0=B, 1=I
+        begin = in_chunk & ((role == 0) | (prev_t != typ))
+        end = in_chunk & ((next_t != typ) | (next_r == 0))
+    elif scheme == "IOE":  # 0=I, 1=E
+        begin = in_chunk & ((prev_t != typ) | (prev_r == 1))
+        end = in_chunk & ((role == 1) | (next_t != typ))
+    else:  # IOBES: 0=B, 1=I, 2=E, 3=S
+        # ref ChunkBegin: B/S always begin; I/E begin after an E/S of the
+        # same type (dangling tags start a chunk); any type change begins.
+        begin = in_chunk & ((role == 0) | (role == 3) | (prev_t != typ)
+                            | (prev_r == 2) | (prev_r == 3))
+        # ref ChunkEnd: E/S always end; B/I end before a B/S of the same
+        # type; any type change ends.
+        end = in_chunk & ((role == 2) | (role == 3) | (next_t != typ)
+                          | (next_r == 0) | (next_r == 3))
+    return begin, end, typ
+
+
+def _next_end_pos(end):
+    """Position of the first chunk end at or after each position (reverse
+    running minimum), +T for none. end: bool [B, T]."""
+    b, t = end.shape
+    pos = jnp.where(end, jnp.arange(t)[None, :], t)
+    return jax.lax.associative_scan(jnp.minimum, pos[:, ::-1],
+                                    axis=1)[:, ::-1]
+
+
+@register("chunk_eval")
+def _chunk_eval(env, op):
+    """Ref ``chunk_eval_op.cc``: chunk-level precision / recall / F1 for
+    sequence labeling under the plain/IOB/IOE/IOBES schemes, with
+    ``excluded_chunk_types`` support, masked by lengths.
+
+    Static-shape formulation: per-position begin/end/type flags; an
+    inference chunk is correct iff the label sequence begins a chunk at
+    the same position with the same type AND both chunks end at the same
+    position (first end >= begin, matching the reference's
+    start+type+end equality)."""
+    inf = get(env, op.input("Inference")).astype(jnp.int32)  # [B, T]
+    lbl = get(env, op.input("Label")).astype(jnp.int32)
+    length = get(env, op.input("SeqLength")).reshape(-1).astype(jnp.int32)
+    num_types = op.attr("num_chunk_types")
+    scheme = op.attr("chunk_scheme", "IOB")
+    excluded = tuple(op.attr("excluded_chunk_types", ()) or ())
+    if inf.ndim == 1:
+        inf = inf[None, :]
+        lbl = lbl[None, :]
+    b, t = inf.shape
+    valid = jnp.arange(t)[None, :] < length[:, None]
+
+    ib, ie, ityp = _chunk_marks(inf, valid, scheme, num_types)
+    lb, le, ltyp = _chunk_marks(lbl, valid, scheme, num_types)
+    if excluded:
+        exc = jnp.asarray(excluded, jnp.int32)
+        ib = ib & ~jnp.any(ityp[..., None] == exc, axis=-1)
+        lb = lb & ~jnp.any(ltyp[..., None] == exc, axis=-1)
+    n_inf = jnp.sum(ib.astype(jnp.int32))
+    n_lbl = jnp.sum(lb.astype(jnp.int32))
+    correct = (ib & lb & (ityp == ltyp)
+               & (_next_end_pos(ie) == _next_end_pos(le)))
+    n_correct = jnp.sum(correct.astype(jnp.int32))
+    p = n_correct / jnp.maximum(n_inf, 1)
+    r = n_correct / jnp.maximum(n_lbl, 1)
+    f1 = 2 * p * r / jnp.maximum(p + r, 1e-8)
+    put(env, op.output("Precision"), p.astype(jnp.float32).reshape(()))
+    put(env, op.output("Recall"), r.astype(jnp.float32).reshape(()))
+    put(env, op.output("F1-Score"), f1.astype(jnp.float32).reshape(()))
+    put(env, op.output("NumInferChunks"), n_inf.astype(jnp.int32))
+    put(env, op.output("NumLabelChunks"), n_lbl.astype(jnp.int32))
+    put(env, op.output("NumCorrectChunks"), n_correct.astype(jnp.int32))
+
+
+@register("positive_negative_pair")
+def _pos_neg_pair(env, op):
+    """Ref ``positive_negative_pair_op.cc``: ranking-quality pair counts
+    within query groups."""
+    score = get(env, op.input("Score")).reshape(-1)
+    label = get(env, op.input("Label")).reshape(-1)
+    qid = get(env, op.input("QueryID")).reshape(-1)
+    same_q = qid[:, None] == qid[None, :]
+    higher_lbl = label[:, None] > label[None, :]
+    pos = jnp.sum((same_q & higher_lbl
+                   & (score[:, None] > score[None, :])).astype(jnp.float32))
+    neg = jnp.sum((same_q & higher_lbl
+                   & (score[:, None] < score[None, :])).astype(jnp.float32))
+    neu = jnp.sum((same_q & higher_lbl
+                   & (score[:, None] == score[None, :]))
+                  .astype(jnp.float32))
+    put(env, op.output("PositivePair"), pos.reshape(()))
+    put(env, op.output("NegativePair"), neg.reshape(()))
+    put(env, op.output("NeutralPair"), neu.reshape(()))
+
+
+# ---------------- image / feature ops ----------------
+
+@register("affine_channel")
+def _affine_channel(env, op):
+    x = get(env, op.input("X"))
+    scale = get(env, op.input("Scale"))
+    bias = get(env, op.input("Bias"))
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    out = x
+    if scale is not None:
+        out = out * scale.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    put(env, op.output("Out"), out)
+
+
+@register("affine_grid")
+def _affine_grid(env, op):
+    """Ref ``affine_grid_op.cc``: theta [N, 2, 3] -> sampling grid."""
+    theta = get(env, op.input("Theta"))
+    h, w = op.attr("output_shape")[-2:]
+    ys = jnp.linspace(-1, 1, h)
+    xs = jnp.linspace(-1, 1, w)
+    gx, gy = jnp.meshgrid(xs, ys)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H, W, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)
+    put(env, op.output("Output"), grid)
+
+
+@register("space_to_depth")
+def _space_to_depth(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    bs = op.attr("blocksize")
+    n, c, h, w = x.shape
+    x = x.reshape(n, c, h // bs, bs, w // bs, bs)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    put(env, op.output("Out"),
+        x.reshape(n, c * bs * bs, h // bs, w // bs))
+
+
+@register("shuffle_channel")
+def _shuffle_channel(env, op):
+    x = get(env, op.input("X"))
+    g = op.attr("group")
+    n, c, h, w = x.shape
+    put(env, op.output("Out"),
+        x.reshape(n, g, c // g, h, w).transpose(0, 2, 1, 3, 4)
+        .reshape(n, c, h, w))
+
+
+@register("crop")
+def _crop(env, op):
+    x = get(env, op.input("X"))
+    offsets = op.attr("offsets")
+    shape = op.attr("shape")
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    put(env, op.output("Out"), x[sl])
+
+
+@register("pad_constant_like")
+def _pad_constant_like(env, op):
+    x = get(env, op.input("X"))  # big
+    y = get(env, op.input("Y"))  # small
+    val = op.attr("pad_value", 0.0)
+    pads = [(0, xd - yd) for xd, yd in zip(x.shape, y.shape)]
+    put(env, op.output("Out"), jnp.pad(y, pads, constant_values=val))
+
+
+@register("pool_with_index")
+def _pool_with_index(env, op):
+    """Ref ``pool_with_index_op.cc`` (max_pool2d_with_index). Mask holds
+    flat indices into the UNPADDED input (-inf padding never wins)."""
+    if op.attr("adaptive", False):
+        # equal-bin adaptive mode (ref AdaptiveStartIndex/EndIndex with
+        # divisible dims): reshape into bins, argmax per bin
+        x = get(env, op.input("X"))
+        n, c, h, w = x.shape
+        oh, ow = op.attr("ksize")[0], op.attr("ksize")[1]
+        assert h % oh == 0 and w % ow == 0, \
+            "adaptive pool_with_index needs divisible dims"
+        bh, bw = h // oh, w // ow
+        xr = x.reshape(n, c, oh, bh, ow, bw).transpose(0, 1, 2, 4, 3, 5) \
+            .reshape(n, c, oh, ow, bh * bw)
+        arg = jnp.argmax(xr, axis=-1)
+        out = jnp.max(xr, axis=-1)
+        by, bx = arg // bw, arg % bw
+        gy = jnp.arange(oh)[None, None, :, None] * bh + by
+        gx = jnp.arange(ow)[None, None, None, :] * bw + bx
+        put(env, op.output("Out"), out)
+        put(env, op.output("Mask"), (gy * w + gx).astype(jnp.int32))
+        return
+    x = get(env, op.input("X"))
+    n, c, h, w = x.shape
+    ks = op.attr("ksize")
+    if op.attr("global_pooling", False):
+        ks = [h, w]
+    strides = op.attr("strides", ks)
+    pads = op.attr("paddings", [0, 0])
+    ph_, pw_ = pads[0], pads[1]
+    if ph_ or pw_:
+        x = jnp.pad(x, ((0, 0), (0, 0), (ph_, ph_), (pw_, pw_)),
+                    constant_values=-jnp.inf)
+    hp, wp = x.shape[2], x.shape[3]
+    kh, kw = ks[0], ks[1]
+    sh, sw = strides[0], strides[1]
+    oh, ow = (hp - kh) // sh + 1, (wp - kw) // sw + 1
+    # window extraction: [N, C, OH, OW, KH*KW]
+    wins = jnp.stack([
+        x[:, :, i:i + sh * oh:sh, j:j + sw * ow:sw]
+        for i in range(kh) for j in range(kw)], axis=-1)
+    arg = jnp.argmax(wins, axis=-1)
+    out = jnp.max(wins, axis=-1)
+    ky, kx = arg // kw, arg % kw
+    gy = jnp.arange(oh)[None, None, :, None] * sh + ky - ph_
+    gx = jnp.arange(ow)[None, None, None, :] * sw + kx - pw_
+    put(env, op.output("Out"), out)
+    put(env, op.output("Mask"), (gy * w + gx).astype(jnp.int32))
+
+
+@register("max_pool3d_with_index")
+def _max_pool3d_with_index(env, op):
+    """Ref ``max_pool_with_index_op.cc`` 3-D variant (NCDHW): max pool +
+    flat argmax indices into the unpadded D*H*W volume."""
+    x = get(env, op.input("X"))
+    n, c, d, h, w = x.shape
+    ks = list(op.attr("ksize"))
+    if op.attr("global_pooling", False):
+        ks = [d, h, w]
+    if op.attr("adaptive", False):
+        od, oh, ow = ks
+        assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+            "adaptive max_pool3d_with_index needs divisible dims"
+        bd, bh, bw = d // od, h // oh, w // ow
+        xr = x.reshape(n, c, od, bd, oh, bh, ow, bw) \
+            .transpose(0, 1, 2, 4, 6, 3, 5, 7) \
+            .reshape(n, c, od, oh, ow, bd * bh * bw)
+        arg = jnp.argmax(xr, axis=-1)
+        out = jnp.max(xr, axis=-1)
+        bz = arg // (bh * bw)
+        by = (arg % (bh * bw)) // bw
+        bx = arg % bw
+        gz = jnp.arange(od)[None, None, :, None, None] * bd + bz
+        gy = jnp.arange(oh)[None, None, None, :, None] * bh + by
+        gx = jnp.arange(ow)[None, None, None, None, :] * bw + bx
+        put(env, op.output("Out"), out)
+        put(env, op.output("Mask"),
+            ((gz * h + gy) * w + gx).astype(jnp.int32))
+        return
+    strides = list(op.attr("strides", ks))
+    pads = list(op.attr("paddings", [0, 0, 0]))
+    pd_, ph_, pw_ = pads[0], pads[1], pads[2]
+    if pd_ or ph_ or pw_:
+        x = jnp.pad(x, ((0, 0), (0, 0), (pd_, pd_), (ph_, ph_),
+                        (pw_, pw_)), constant_values=-jnp.inf)
+    dp, hp, wp = x.shape[2], x.shape[3], x.shape[4]
+    kd, kh, kw = ks
+    sd, sh, sw = strides
+    od = (dp - kd) // sd + 1
+    oh = (hp - kh) // sh + 1
+    ow = (wp - kw) // sw + 1
+    wins = jnp.stack([
+        x[:, :, a:a + sd * od:sd, i:i + sh * oh:sh, j:j + sw * ow:sw]
+        for a in range(kd) for i in range(kh) for j in range(kw)], axis=-1)
+    arg = jnp.argmax(wins, axis=-1)
+    out = jnp.max(wins, axis=-1)
+    kz = arg // (kh * kw)
+    ky = (arg % (kh * kw)) // kw
+    kx = arg % kw
+    gz = jnp.arange(od)[None, None, :, None, None] * sd + kz - pd_
+    gy = jnp.arange(oh)[None, None, None, :, None] * sh + ky - ph_
+    gx = jnp.arange(ow)[None, None, None, None, :] * sw + kx - pw_
+    put(env, op.output("Out"), out)
+    put(env, op.output("Mask"), ((gz * h + gy) * w + gx).astype(jnp.int32))
+
+
+@register("unpool")
+def _unpool(env, op):
+    """Ref ``unpool_op.cc``: scatter pooled values back by max indices."""
+    x = get(env, op.input("X"))
+    mask = get(env, op.input("Indices")).astype(jnp.int32)
+    oh, ow = op.attr("unpooled_height"), op.attr("unpooled_width")
+    n, c, h, w = x.shape
+    flat = jnp.zeros((n, c, oh * ow), x.dtype)
+    nidx = jnp.arange(n)[:, None, None, None]
+    cidx = jnp.arange(c)[None, :, None, None]
+    out = flat.at[nidx, cidx, mask].set(x)
+    put(env, op.output("Out"), out.reshape(n, c, oh, ow))
+
+
+@register("psroi_pool")
+def _psroi_pool(env, op):
+    """Ref ``psroi_pool_op.cc``: position-sensitive ROI average pooling
+    (batch-0 rois, fixed count — the repo ROI convention)."""
+    x = get(env, op.input("X"))  # [N, C, H, W], C = out_c * ph * pw
+    rois = get(env, op.input("ROIs"))  # [R, 4]
+    out_c = op.attr("output_channels")
+    ph = op.attr("pooled_height")
+    pw = op.attr("pooled_width")
+    scale = op.attr("spatial_scale", 1.0)
+    n, c, h, w = x.shape
+
+    def one(roi):
+        x1, y1, x2, y2 = roi * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / ph, rw / pw
+        outs = []
+        for i in range(ph):
+            for j in range(pw):
+                ys = jnp.arange(h)
+                xs = jnp.arange(w)
+                in_y = ((ys >= jnp.floor(y1 + i * bin_h))
+                        & (ys < jnp.ceil(y1 + (i + 1) * bin_h)))
+                in_x = ((xs >= jnp.floor(x1 + j * bin_w))
+                        & (xs < jnp.ceil(x1 + (j + 1) * bin_w)))
+                m = in_y[:, None] & in_x[None, :]
+                cnt = jnp.maximum(jnp.sum(m.astype(x.dtype)), 1.0)
+                chan = (i * pw + j) * out_c + jnp.arange(out_c)
+                vals = jnp.sum(jnp.where(m[None], x[0, chan], 0.0),
+                               axis=(1, 2)) / cnt
+                outs.append(vals)
+        return jnp.stack(outs, axis=1).reshape(out_c, ph, pw)
+
+    put(env, op.output("Out"), jax.vmap(one)(rois))
+
+
+@register("spp")
+def _spp(env, op):
+    """Ref ``spp_op.cc``: spatial pyramid pooling."""
+    x = get(env, op.input("X"))
+    levels = op.attr("pyramid_height")
+    ptype = op.attr("pooling_type", "max")
+    n, c, h, w = x.shape
+    outs = []
+    for lv in range(levels):
+        bins = 2 ** lv
+        ys = [int(round(i * h / bins)) for i in range(bins + 1)]
+        xs = [int(round(i * w / bins)) for i in range(bins + 1)]
+        for i in range(bins):
+            for j in range(bins):
+                patch = x[:, :, ys[i]:max(ys[i + 1], ys[i] + 1),
+                          xs[j]:max(xs[j + 1], xs[j] + 1)]
+                red = jnp.max if ptype == "max" else jnp.mean
+                outs.append(red(patch, axis=(2, 3)))
+    put(env, op.output("Out"), jnp.concatenate(outs, axis=1))
+
+
+@register("similarity_focus")
+def _similarity_focus(env, op):
+    """Ref ``similarity_focus_op.cc``: focus mask from max positions of
+    selected channels."""
+    x = get(env, op.input("X"))  # [N, d1, d2, d3], axis in {1, 2, 3}
+    axis = op.attr("axis")
+    indexes = op.attr("indexes")
+    if axis not in (1, 2, 3):
+        raise ValueError("similarity_focus: axis must be 1, 2 or 3")
+    # normalize to the axis=1 layout, compute, and restore
+    perm = {1: (0, 1, 2, 3), 2: (0, 2, 1, 3), 3: (0, 3, 1, 2)}[axis]
+    inv = tuple(perm.index(i) for i in range(4))
+    xt = jnp.transpose(x, perm)
+    mask = jnp.zeros_like(xt)
+    for idx in indexes:
+        sel = xt[:, idx]  # [N, A, B]
+        ra = jnp.max(sel, axis=2, keepdims=True) == sel
+        rb = jnp.max(sel, axis=1, keepdims=True) == sel
+        m = (ra | rb).astype(xt.dtype)[:, None]
+        mask = jnp.maximum(mask, jnp.broadcast_to(m, mask.shape))
+    put(env, op.output("Out"), jnp.transpose(mask, inv))
+
+
+@register("spectral_norm")
+def _spectral_norm(env, op):
+    """Ref ``spectral_norm_op.cc``: weight / sigma via power iteration
+    with the persisted u/v vectors."""
+    w = get(env, op.input("Weight"))
+    u = get(env, op.input("U")).reshape(-1)
+    v = get(env, op.input("V")).reshape(-1)
+    dim = op.attr("dim", 0)
+    iters = op.attr("power_iters", 1)
+    eps = op.attr("eps", 1e-12)
+    mat = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+    for _ in range(max(iters, 0)):
+        v = mat.T @ u
+        v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+        u = mat @ v
+        u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+    sigma = u @ mat @ v
+    put(env, op.output("Out"), w / jnp.maximum(sigma, eps))
+
+
+@register("random_crop")
+def _random_crop(env, op):
+    x = get(env, op.input("X"))
+    shape = op.attr("shape")
+    seed = op.attr("seed", None)
+    key = (jax.random.PRNGKey(int(seed)) if seed is not None
+           else next_rng(env))
+    starts = []
+    for i, (xd, sd) in enumerate(zip(x.shape[-len(shape):], shape)):
+        key, sub = jax.random.split(key)
+        starts.append(jax.random.randint(sub, (), 0, xd - sd + 1))
+    lead = x.ndim - len(shape)
+    idx = [0] * lead + list(starts)
+    sizes = list(x.shape[:lead]) + list(shape)
+    put(env, op.output("Out"),
+        jax.lax.dynamic_slice(x, idx, sizes))
+
+
+# ---------------- misc tensor ops ----------------
+
+@register("multiplex")
+def _multiplex(env, op):
+    """Ref ``multiplex_op.cc``: out[i] = candidates[ids[i]][i]."""
+    ids = get(env, op.input("Ids")).reshape(-1).astype(jnp.int32)
+    xs = [get(env, v) for v in op.input_list("X")]
+    stacked = jnp.stack(xs, axis=0)  # [K, B, ...]
+    put(env, op.output("Out"), stacked[ids, jnp.arange(ids.shape[0])])
+
+
+@register("is_empty")
+def _is_empty(env, op):
+    x = get(env, op.input("X"))
+    put(env, op.output("Out"), jnp.asarray(x.size == 0))
+
+
+@register("minus")
+def _minus(env, op):
+    put(env, op.output("Out"),
+        get(env, op.input("X")) - get(env, op.input("Y")))
+
+
+@register("selu")
+def _selu(env, op):
+    x = get(env, op.input("X"))
+    scale = op.attr("scale", 1.0507009873554805)
+    alpha = op.attr("alpha", 1.6732632423543772)
+    put(env, op.output("Out"),
+        scale * jnp.where(x > 0, x, alpha * (jnp.exp(x) - 1.0)))
+
+
+@register("bilinear_tensor_product")
+def _bilinear_tensor_product(env, op):
+    """Ref ``bilinear_tensor_product_op.cc``: out_k = x W_k y^T + b."""
+    x = get(env, op.input("X"))  # [B, M]
+    y = get(env, op.input("Y"))  # [B, N]
+    w = get(env, op.input("Weight"))  # [K, M, N]
+    bias = get(env, op.input("Bias"))
+    out = jnp.einsum("bm,kmn,bn->bk", x, w, y)
+    if bias is not None:
+        out = out + bias.reshape(1, -1)
+    put(env, op.output("Out"), out)
+
+
+@register("add_position_encoding")
+def _add_position_encoding(env, op):
+    """Ref ``add_position_encoding_op.cc``: sinusoidal PE added in place."""
+    x = get(env, op.input("X"))  # [B, T, D]
+    alpha = op.attr("alpha", 1.0)
+    beta = op.attr("beta", 1.0)
+    b, t, d = x.shape
+    if d % 2:
+        raise ValueError(
+            "add_position_encoding requires an even encode size; got %d "
+            "(ref enforces enc_size %% 2 == 0)" % d)
+    half = d // 2
+    pos = jnp.arange(t, dtype=jnp.float32)[:, None]
+    i = jnp.arange(half, dtype=jnp.float32)[None, :]
+    # ref kernel's frequency exponent is k/(half_size-1), NOT 2k/d
+    denom = float(max(half - 1, 1))
+    angle = pos / jnp.power(10000.0, i / denom)
+    pe = jnp.concatenate([jnp.sin(angle), jnp.cos(angle)], axis=1)
+    put(env, op.output("Out"), alpha * x + beta * pe[None])
+
+
+@register("conv_shift")
+def _conv_shift(env, op):
+    """Ref ``conv_shift_op.cc``: circular correlation."""
+    x = get(env, op.input("X"))  # [B, M]
+    y = get(env, op.input("Y"))  # [B, N], N odd, N <= M
+    m = x.shape[1]
+    n = y.shape[1]
+    half = n // 2
+    idx = (jnp.arange(m)[:, None] + jnp.arange(-half, half + 1)[None, :]) % m
+    put(env, op.output("Out"),
+        jnp.einsum("bmn,bn->bm", x[:, idx], y))
+
+
+@register("hash")
+def _hash(env, op):
+    """Ref ``hash_op.cc``: xxhash-style bucketed ids (capability parity:
+    deterministic multiplicative hash into num_hash buckets)."""
+    x = get(env, op.input("X")).astype(jnp.uint32)  # [B, T]
+    num_hash = op.attr("num_hash", 1)
+    mod = op.attr("mod_by", 100000007)
+    outs = []
+    for i in range(num_hash):
+        # multiplicative hash in wraparound uint32 (x64 stays disabled)
+        seed = jnp.uint32((0x9E3779B1 + i * 0x85EBCA77) & 0xFFFFFFFF)
+        h = (x * seed) % jnp.uint32(mod)
+        outs.append(h.astype(jnp.int32))
+    put(env, op.output("Out"), jnp.stack(outs, axis=-2))
+
+
+@register("data_norm")
+def _data_norm(env, op):
+    """Ref ``data_norm_op.cc``: normalization by accumulated batch stats
+    (CTR models); stats updated like summary counters."""
+    x = get(env, op.input("X"))
+    size = get(env, op.input("BatchSize"))
+    total = get(env, op.input("BatchSum"))
+    sq = get(env, op.input("BatchSquareSum"))
+    mean = total / jnp.maximum(size, 1e-4)
+    var = sq / jnp.maximum(size, 1e-4) - jnp.square(mean)
+    scale = jax.lax.rsqrt(jnp.maximum(var, 1e-4))
+    put(env, op.output("Y"), (x - mean) * scale)
+    put(env, op.output("Means"), mean)
+    put(env, op.output("Scales"), scale)
+    n = x.shape[0]
+    put(env, op.output("BatchSizeOut"), size + n)
+    put(env, op.output("BatchSumOut"), total + jnp.sum(x, axis=0))
+    put(env, op.output("BatchSquareSumOut"),
+        sq + jnp.sum(jnp.square(x), axis=0))
+
+
+# ---------------- sequence utilities ----------------
+
+@register("sequence_expand_as")
+def _sequence_expand_as(env, op):
+    """Padded re-design of ``sequence_expand_as_op.cc``: tile each row of
+    X to the length of the corresponding Y row (lengths input)."""
+    x = get(env, op.input("X"))          # [B, ...]
+    y_len = get(env, op.input("YLength")).reshape(-1).astype(jnp.int32)
+    maxlen = op.attr("maxlen")
+    tiled = jnp.repeat(x[:, None], maxlen, axis=1)
+    mask = jnp.arange(maxlen)[None, :] < y_len[:, None]
+    shape = mask.shape + (1,) * (x.ndim - 1)
+    put(env, op.output("Out"), tiled * mask.reshape(shape).astype(x.dtype))
+
+
+@register("sequence_reshape")
+def _sequence_reshape(env, op):
+    x = get(env, op.input("X"))  # [B, T, D]
+    new_dim = op.attr("new_dim")
+    b = x.shape[0]
+    put(env, op.output("Out"), x.reshape(b, -1, new_dim))
+
+
+@register("sequence_scatter")
+def _sequence_scatter(env, op):
+    """Padded ``sequence_scatter_op.cc``: scatter per-row updates at
+    per-row index lists."""
+    x = get(env, op.input("X"))          # [B, D]
+    ids = get(env, op.input("Ids")).astype(jnp.int32)  # [B, T]
+    upd = get(env, op.input("Updates"))  # [B, T]
+    mask = get(env, op.input("Mask"))
+    if mask is not None:
+        upd = upd * mask
+    b = x.shape[0]
+    bidx = jnp.arange(b)[:, None].repeat(ids.shape[1], 1)
+    put(env, op.output("Out"), x.at[bidx, ids].add(upd))
+
+
+# ---------------- optimizer extras ----------------
+
+@register("proximal_gd")
+def _proximal_gd(env, op):
+    """Ref ``proximal_gd_op.cc``: prox step with L1/L2."""
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    lr = get(env, op.input("LearningRate")).reshape(())
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    prox = p - lr * g
+    new_p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - lr * l1, 0.0) \
+        / (1.0 + lr * l2)
+    put(env, op.output("ParamOut"), new_p)
+
+
+@register("proximal_adagrad")
+def _proximal_adagrad(env, op):
+    p = get(env, op.input("Param"))
+    g = get(env, op.input("Grad"))
+    m = get(env, op.input("Moment"))
+    lr = get(env, op.input("LearningRate")).reshape(())
+    l1 = op.attr("l1", 0.0)
+    l2 = op.attr("l2", 0.0)
+    m_new = m + g * g
+    alr = lr / jnp.sqrt(m_new + 1e-10)
+    prox = p - alr * g
+    new_p = jnp.sign(prox) * jnp.maximum(jnp.abs(prox) - alr * l1, 0.0) \
+        / (1.0 + alr * l2)
+    put(env, op.output("ParamOut"), new_p)
+    put(env, op.output("MomentOut"), m_new)
+
+
+@register("sample_logits")
+def _sample_logits(env, op):
+    """Ref ``sample_logits_op.cc``: gather true + uniformly sampled class
+    logits for sampled softmax."""
+    logits = get(env, op.input("Logits"))  # [B, C]
+    labels = get(env, op.input("Labels")).astype(jnp.int32)  # [B, 1]
+    num = op.attr("num_samples")
+    b, c = logits.shape
+    key = next_rng(env)
+    samples = jax.random.randint(key, (b, num), 0, c)
+    all_idx = jnp.concatenate([labels.reshape(b, 1), samples], axis=1)
+    out = jnp.take_along_axis(logits, all_idx, axis=1)
+    # log-Q correction (sampled-softmax convention: subtract log q from
+    # EVERY column, true class included — under uniform q it cancels in
+    # the softmax but keeps logits comparable to the reference's)
+    logq = float(np.log(max(num, 1) / float(c)))
+    out = out - logq
+    put(env, op.output("SampledLogits"), out)
+    put(env, op.output("Samples"), all_idx)
+    put(env, op.output("SampledLabels"), jnp.zeros((b,), jnp.int32))
+
+
+@register("lstm_unit")
+def _lstm_unit(env, op):
+    """Ref ``lstm_unit_op.cc``: one fused LSTM cell step."""
+    x = get(env, op.input("X"))     # [B, 4H] pre-activations
+    c_prev = get(env, op.input("C_prev"))
+    forget_bias = op.attr("forget_bias", 0.0)
+    h4 = x.shape[1] // 4
+    i, f, o, j = (x[:, :h4], x[:, h4:2 * h4], x[:, 2 * h4:3 * h4],
+                  x[:, 3 * h4:])
+    c = (c_prev * jax.nn.sigmoid(f + forget_bias)
+         + jax.nn.sigmoid(i) * jnp.tanh(j))
+    h = jnp.tanh(c) * jax.nn.sigmoid(o)
+    put(env, op.output("C"), c)
+    put(env, op.output("H"), h)
+
+
+@register("ctc_align")
+def _ctc_align(env, op):
+    """Ref ``ctc_align_op.cc``: CTC greedy decode post-processing — merge
+    repeats, drop blanks. Padded re-design: [B, T] ids + lengths in,
+    front-compacted [B, T] ids (padding_value tail) + OutLength out."""
+    x = get(env, op.input("Input")).astype(jnp.int32)  # [B, T]
+    lens = get(env, op.input("InputLength"))
+    blank = op.attr("blank", 0)
+    pad_val = op.attr("padding_value", 0)
+    b, t = x.shape
+    pos = jnp.arange(t)[None, :]
+    if lens is None:  # optional: default to full time dimension
+        valid = jnp.ones((b, t), bool)
+        lens = jnp.full((b,), t, jnp.int32)
+    else:
+        valid = pos < lens.reshape(-1, 1)
+    first = pos == 0
+    keep = valid & (x != blank) & (first | (x != jnp.roll(x, 1, axis=1)))
+    # stable front-compaction: order by (dropped, position)
+    order = jnp.argsort(jnp.where(keep, pos, t + pos), axis=1)
+    compacted = jnp.take_along_axis(x, order, axis=1)
+    n_keep = jnp.sum(keep.astype(jnp.int32), axis=1)
+    out = jnp.where(pos < n_keep[:, None], compacted, pad_val)
+    put(env, op.output("Output"), out)
+    put(env, op.output("OutputLength"), n_keep)
+
+
+@register("detection_map")
+def _detection_map(env, op):
+    """Ref ``detection_map_op.cc``: mean average precision over classes.
+
+    Fixed-shape re-design of the LoD inputs: DetectRes [N, D, 6]
+    (label, score, x1, y1, x2, y2; label < 0 = padding), GtLabel [N, G],
+    GtBox [N, G, 4] (zero-area rows = padding). 'integral' or '11point'
+    AP; greedy score-ordered matching, one gt per detection."""
+    det = get(env, op.input("DetectRes"))
+    gt_label = get(env, op.input("GtLabel")).astype(jnp.int32)
+    gt_box = get(env, op.input("GtBox"))
+    iou_t = op.attr("overlap_threshold", 0.5)
+    ap_type = op.attr("ap_type", "integral")
+    class_num = int(op.attr("class_num"))
+    n, d_cnt, _ = det.shape
+    g_cnt = gt_box.shape[1]
+
+    from .detection_ops import _iou_matrix
+
+    gt_valid = (gt_box[..., 2] > gt_box[..., 0]) \
+        & (gt_box[..., 3] > gt_box[..., 1])
+
+    # flatten detections with their image index; sort all by score desc
+    img_idx = jnp.repeat(jnp.arange(n), d_cnt)
+    dl = det[..., 0].reshape(-1).astype(jnp.int32)
+    ds = det[..., 1].reshape(-1)
+    db = det[..., 2:].reshape(-1, 4)
+    d_valid = dl >= 0
+    order = jnp.argsort(jnp.where(d_valid, -ds, jnp.inf))
+    img_idx, dl, db, d_valid = (img_idx[order], dl[order], db[order],
+                                d_valid[order])
+
+    # class-independent IoU rows, computed ONCE (not per vmapped class)
+    ious = jax.vmap(lambda bx, ii: _iou_matrix(
+        bx[None], gt_box[ii], norm=False)[0])(db, img_idx)  # [ND, G]
+
+    def run_class(c):
+        n_gt = jnp.sum((gt_label == c) & gt_valid)
+
+        def step(used, i):
+            # used: [N, G] gt-consumed flags. Reference semantics
+            # (detection_map_op.cc): a detection matches ONLY its
+            # argmax-IoU same-class gt; if that gt was already consumed
+            # by a higher-scored detection, this one is a false positive.
+            iou = ious[i]
+            same = (gt_label[img_idx[i]] == c) & gt_valid[img_idx[i]]
+            cand = jnp.where(same, iou, -1.0)
+            j = jnp.argmax(cand)
+            overlap_ok = cand[j] >= iou_t
+            fresh = ~used[img_idx[i], j]
+            hit = overlap_ok & fresh & d_valid[i] & (dl[i] == c)
+            used = used.at[img_idx[i], j].set(used[img_idx[i], j] | hit)
+            tp = jnp.where(d_valid[i] & (dl[i] == c),
+                           jnp.where(hit, 1.0, 0.0), jnp.nan)
+            return used, tp
+
+        used0 = jnp.zeros((n, g_cnt), bool)
+        _, tps = jax.lax.scan(step, used0, jnp.arange(img_idx.shape[0]))
+        is_c = ~jnp.isnan(tps)
+        tp = jnp.where(is_c, tps, 0.0)
+        fp = jnp.where(is_c, 1.0 - tps, 0.0)
+        ctp = jnp.cumsum(tp)
+        cfp = jnp.cumsum(fp)
+        recall = ctp / jnp.maximum(n_gt, 1)
+        precision = ctp / jnp.maximum(ctp + cfp, 1e-9)
+        if ap_type == "11point":
+            pts = jnp.linspace(0.0, 1.0, 11)
+            pmax = jax.vmap(lambda r: jnp.max(
+                jnp.where(recall >= r, precision, 0.0)))(pts)
+            ap = jnp.mean(pmax)
+        else:  # integral
+            d_rec = jnp.diff(jnp.concatenate([jnp.zeros((1,)), recall]))
+            ap = jnp.sum(precision * d_rec * is_c)
+        return jnp.where(n_gt > 0, ap, jnp.nan)
+
+    bg = op.attr("background_label", 0)
+    classes = jnp.asarray([c for c in range(class_num) if c != bg],
+                          jnp.int32)  # bg=-1 evaluates every class
+    aps = jax.vmap(run_class)(classes)
+    present = ~jnp.isnan(aps)
+    m_ap = jnp.sum(jnp.where(present, aps, 0.0)) / jnp.maximum(
+        jnp.sum(present.astype(jnp.float32)), 1.0)
+    put(env, op.output("MAP"), m_ap.reshape(()))

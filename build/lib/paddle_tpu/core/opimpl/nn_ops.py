@@ -1,0 +1,766 @@
+"""Neural-network ops: conv / pool / normalization / dropout / softmax /
+losses / interpolation.
+
+Reference kernels: ``paddle/fluid/operators/conv_op.cc`` (+cudnn),
+``pool_op.cc``, ``batch_norm_op.cc``, ``layer_norm_op.cc``, ``dropout_op.cc``,
+``softmax_with_cross_entropy_op.cc``, ``interpolate_op.cc`` etc. Lowered to
+lax convolutions / reduce_window / jnp so XLA maps convs+matmuls onto the MXU
+and fuses the elementwise epilogues.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..op_registry import register, get, put, next_rng
+
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+# ---------------- convolution family ----------------
+
+@register("conv2d", "depthwise_conv2d")
+def _conv2d(env, op):
+    x = get(env, op.input("Input"))  # NCHW
+    w = get(env, op.input("Filter"))  # OIHW
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1)
+    if op.type == "depthwise_conv2d":
+        groups = x.shape[1]
+    from ..op_registry import mxu_cast
+    x, w = mxu_cast(x, w)
+    # bf16 in -> bf16 out under AMP: the TPU conv unit accumulates fp32
+    # internally and rounds once at the output. (An explicit f32
+    # preferred_element_type would break lax's conv transpose rule, which
+    # requires cotangent and operand dtypes to match.)
+    out = jax.lax.conv_general_dilated(
+        x, w,
+        window_strides=strides,
+        padding=[(pads[0], pads[0]), (pads[1], pads[1])],
+        rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    put(env, op.output("Output"), out)
+
+
+@register("conv3d")
+def _conv3d(env, op):
+    x = get(env, op.input("Input"))  # NCDHW
+    w = get(env, op.input("Filter"))
+    s = tuple(op.attr("strides", [1, 1, 1]))
+    p = tuple(op.attr("paddings", [0, 0, 0]))
+    d = tuple(op.attr("dilations", [1, 1, 1]))
+    out = jax.lax.conv_general_dilated(
+        x, w, window_strides=s,
+        padding=[(p[0], p[0]), (p[1], p[1]), (p[2], p[2])],
+        rhs_dilation=d,
+        feature_group_count=op.attr("groups", 1),
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    put(env, op.output("Output"), out)
+
+
+def conv_transpose_nchw(x, w, strides, pads, dil, groups=1):
+    """Transposed conv as a fractionally-strided conv (the reference
+    kernel's semantics, ``conv_transpose_op.cc``): w is IOHW
+    [Cin, Cout/groups, kh, kw]; output spatial = (i-1)*s - 2p + d*(k-1)+1.
+    lhs_dilation inserts the stride zeros; the kernel is spatially flipped
+    and I/O-swapped per group into OIHW."""
+    cin = w.shape[0]
+    cog = w.shape[1]  # Cout / groups
+    wf = jnp.flip(w, axis=(2, 3))
+    if groups == 1:
+        wt = wf.transpose(1, 0, 2, 3)  # [Cout, Cin, kh, kw]
+    else:
+        wg = wf.reshape((groups, cin // groups, cog) + w.shape[2:])
+        wt = wg.transpose(0, 2, 1, 3, 4).reshape(
+            (groups * cog, cin // groups) + w.shape[2:])
+    kh = (w.shape[2] - 1) * dil[0] + 1
+    kw = (w.shape[3] - 1) * dil[1] + 1
+    return jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1),
+        padding=[(kh - 1 - pads[0], kh - 1 - pads[0]),
+                 (kw - 1 - pads[1], kw - 1 - pads[1])],
+        lhs_dilation=strides,
+        rhs_dilation=dil,
+        feature_group_count=groups,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+@register("conv2d_transpose")
+def _conv2d_transpose(env, op):
+    x = get(env, op.input("Input"))
+    w = get(env, op.input("Filter"))  # IOHW in paddle transpose conv
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    from ..op_registry import mxu_cast
+    x, w = mxu_cast(x, w)
+    put(env, op.output("Output"),
+        conv_transpose_nchw(x, w, strides, pads, dil,
+                            op.attr("groups", 1) or 1))
+
+
+# ---------------- pooling ----------------
+
+@register("pool2d")
+def _pool2d(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    ptype = op.attr("pooling_type", "max")
+    ksize = _pair(op.attr("ksize"))
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    global_pool = op.attr("global_pooling", False)
+    adaptive = op.attr("adaptive", False)
+    exclusive = op.attr("exclusive", True)
+    ceil_mode = op.attr("ceil_mode", False)
+
+    if global_pool or (adaptive and ksize == (1, 1)):
+        red = jnp.max if ptype == "max" else jnp.mean
+        put(env, op.output("Out"), red(x, axis=(2, 3), keepdims=True))
+        return
+    if adaptive:
+        # adaptive pooling to output size ksize: split H/W into equal bins
+        n, c, h, w = x.shape
+        oh, ow = ksize
+        assert h % oh == 0 and w % ow == 0, "adaptive pool needs divisible dims"
+        xr = x.reshape(n, c, oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        put(env, op.output("Out"), red(xr, axis=(3, 5)))
+        return
+
+    pad_cfg = [(0, 0), (0, 0), (pads[0], pads[0]), (pads[1], pads[1])]
+    if ceil_mode:
+        # extend padding on the high side so the last window fits
+        n, c, h, w = x.shape
+        out_h = -(-(h + 2 * pads[0] - ksize[0]) // strides[0]) + 1
+        out_w = -(-(w + 2 * pads[1] - ksize[1]) // strides[1]) + 1
+        need_h = (out_h - 1) * strides[0] + ksize[0] - (h + 2 * pads[0])
+        need_w = (out_w - 1) * strides[1] + ksize[1] - (w + 2 * pads[1])
+        pad_cfg = [(0, 0), (0, 0),
+                   (pads[0], pads[0] + max(0, need_h)),
+                   (pads[1], pads[1] + max(0, need_w))]
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride, pad_cfg)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride, pad_cfg)
+        if exclusive and (pads != (0, 0) or ceil_mode):
+            ones = jnp.ones_like(x)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, stride, pad_cfg)
+            out = s / cnt
+        else:
+            out = s / float(ksize[0] * ksize[1])
+    put(env, op.output("Out"), out)
+
+
+def _triple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+@register("pool3d")
+def _pool3d(env, op):
+    """Ref ``pool_op.cc`` pool3d (NCDHW): max/avg over 3-D windows with
+    ceil_mode / exclusive / adaptive / global parity."""
+    x = get(env, op.input("X"))  # NCDHW
+    ptype = op.attr("pooling_type", "max")
+    ksize = _triple(op.attr("ksize"))
+    strides = _triple(op.attr("strides", [1, 1, 1]))
+    pads = _triple(op.attr("paddings", [0, 0, 0]))
+    if op.attr("global_pooling", False):
+        red = jnp.max if ptype == "max" else jnp.mean
+        put(env, op.output("Out"), red(x, axis=(2, 3, 4), keepdims=True))
+        return
+    if op.attr("adaptive", False):
+        n, c, d, h, w = x.shape
+        od, oh, ow = ksize
+        assert d % od == 0 and h % oh == 0 and w % ow == 0, \
+            "adaptive pool3d needs divisible dims"
+        xr = x.reshape(n, c, od, d // od, oh, h // oh, ow, w // ow)
+        red = jnp.max if ptype == "max" else jnp.mean
+        put(env, op.output("Out"), red(xr, axis=(3, 5, 7)))
+        return
+    pad_cfg = [(0, 0), (0, 0)] + [(p, p) for p in pads]
+    if op.attr("ceil_mode", False):
+        n, c = x.shape[:2]
+        for i, (sp, kk, st, pp) in enumerate(zip(x.shape[2:], ksize,
+                                                 strides, pads)):
+            out_i = -(-(sp + 2 * pp - kk) // st) + 1
+            need = (out_i - 1) * st + kk - (sp + 2 * pp)
+            pad_cfg[2 + i] = (pp, pp + max(0, need))
+    window = (1, 1) + ksize
+    stride = (1, 1) + strides
+    if ptype == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        out = jax.lax.reduce_window(x, init, jax.lax.max, window, stride,
+                                    pad_cfg)
+    else:
+        s = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, stride,
+                                  pad_cfg)
+        if op.attr("exclusive", True) and (any(pads)
+                                           or op.attr("ceil_mode", False)):
+            cnt = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                        window, stride, pad_cfg)
+            out = s / cnt
+        else:
+            out = s / float(ksize[0] * ksize[1] * ksize[2])
+    put(env, op.output("Out"), out)
+
+
+@register("conv3d_transpose")
+def _conv3d_transpose(env, op):
+    """Ref ``conv_transpose_op.cc`` conv3d_transpose (NCDHW, IODHW
+    kernel): fractionally-strided conv, like the 2-D case."""
+    x = get(env, op.input("Input"))
+    w = get(env, op.input("Filter"))  # [Cin, Cout/g, kd, kh, kw]
+    s = _triple(op.attr("strides", [1, 1, 1]))
+    p = _triple(op.attr("paddings", [0, 0, 0]))
+    d = _triple(op.attr("dilations", [1, 1, 1]))
+    groups = op.attr("groups", 1) or 1
+    from ..op_registry import mxu_cast
+    x, w = mxu_cast(x, w)
+    cin, cog = w.shape[0], w.shape[1]
+    wf = jnp.flip(w, axis=(2, 3, 4))
+    if groups == 1:
+        wt = wf.transpose(1, 0, 2, 3, 4)
+    else:
+        wg = wf.reshape((groups, cin // groups, cog) + w.shape[2:])
+        wt = wg.transpose(0, 2, 1, 3, 4, 5).reshape(
+            (groups * cog, cin // groups) + w.shape[2:])
+    kd = [(w.shape[2 + i] - 1) * d[i] + 1 for i in range(3)]
+    out = jax.lax.conv_general_dilated(
+        x, wt, window_strides=(1, 1, 1),
+        padding=[(kd[i] - 1 - p[i], kd[i] - 1 - p[i]) for i in range(3)],
+        lhs_dilation=s, rhs_dilation=d,
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+    put(env, op.output("Output"), out)
+
+
+@register("depthwise_conv2d_transpose")
+def _depthwise_conv2d_transpose(env, op):
+    """Ref ``conv_transpose_op.cc`` depthwise variant: groups == Cin."""
+    x = get(env, op.input("Input"))
+    w = get(env, op.input("Filter"))
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    from ..op_registry import mxu_cast
+    x, w = mxu_cast(x, w)
+    put(env, op.output("Output"),
+        conv_transpose_nchw(x, w, strides, pads, dil, groups=x.shape[1]))
+
+
+# ---------------- normalization ----------------
+
+@register("batch_norm")
+def _batch_norm(env, op):
+    """Train: normalize by batch stats and update moving stats
+    (``MeanOut``/``VarianceOut`` alias the moving-stat vars, matching the
+    reference's in-place contract ``batch_norm_op.cc``). Test: moving stats.
+    """
+    x = get(env, op.input("X"))
+    scale = get(env, op.input("Scale"))
+    bias = get(env, op.input("Bias"))
+    mean = get(env, op.input("Mean"))
+    var = get(env, op.input("Variance"))
+    eps = op.attr("epsilon", 1e-5)
+    momentum = op.attr("momentum", 0.9)
+    is_test = op.attr("is_test", False)
+    layout = op.attr("data_layout", "NCHW")
+    axes = tuple(i for i in range(x.ndim)
+                 if i != (1 if layout == "NCHW" else x.ndim - 1))
+    c_shape = [1] * x.ndim
+    c_shape[1 if layout == "NCHW" else x.ndim - 1] = -1
+
+    # stats + normalization in fp32 even for bf16 inputs (AMP): the casts
+    # fuse into the reduction/epilogue reads. The normalized output is
+    # stored back in the input dtype — keeping activations bf16 between
+    # conv layers halves HBM traffic, and the next conv recasts anyway.
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+
+    if is_test or op.attr("use_global_stats", False):
+        use_mean, use_var = mean, var
+        put(env, op.output("MeanOut"), mean)
+        put(env, op.output("VarianceOut"), var)
+    else:
+        # one-pass stats: sum and sumsq fuse into a single read of the
+        # conv output (jnp.var's two-pass formulation re-reads the whole
+        # activation — measured +7.6% on resnet50). The E[x^2]-E[x]^2
+        # cancellation caveat for channels with |mean| >> std matches the
+        # reference stack's numerics: cuDNN's CUDNN_BATCHNORM_SPATIAL
+        # (what `batch_norm_op.cu` calls) computes the same single-pass
+        # f32 moments with the same documented precision bound. Centered
+        # or subsampled-shift variants were measured and force a second
+        # (partial) read: 0.3346 plain / 0.2774 shifted vs_baseline.
+        n = 1
+        for i in axes:
+            n *= x.shape[i]
+        s1 = jnp.sum(x, axis=axes)
+        s2 = jnp.sum(x * x, axis=axes)
+        use_mean = s1 / n
+        use_var = jnp.maximum(s2 / n - use_mean * use_mean, 0.0)
+        # moving-stat update must not backprop into params
+        bm = jax.lax.stop_gradient(use_mean)
+        bv = jax.lax.stop_gradient(use_var)
+        put(env, op.output("MeanOut"), momentum * mean + (1 - momentum) * bm)
+        put(env, op.output("VarianceOut"), momentum * var + (1 - momentum) * bv)
+        put(env, op.output("SavedMean"), bm)
+        put(env, op.output("SavedVariance"), bv)
+
+    inv = jax.lax.rsqrt(use_var.reshape(c_shape) + eps)
+    y = (x - use_mean.reshape(c_shape)) * inv * scale.reshape(c_shape) \
+        + bias.reshape(c_shape)
+    put(env, op.output("Y"), y.astype(in_dtype))
+
+
+@register("layer_norm")
+def _layer_norm(env, op):
+    x = get(env, op.input("X"))
+    scale = get(env, op.input("Scale"))
+    bias = get(env, op.input("Bias"))
+    eps = op.attr("epsilon", 1e-5)
+    begin = op.attr("begin_norm_axis", 1)
+    if begin == x.ndim - 1:
+        # last-axis normalization: fused Pallas fwd+bwd (one HBM pass per
+        # direction instead of XLA's ~5 — ops/fused_layer_norm.py)
+        from ...ops.fused_layer_norm import fused_layer_norm, _use_fused
+
+        if _use_fused(x.shape[-1]):
+            y, mean, var = fused_layer_norm(x, scale, bias, eps)
+            put(env, op.output("Y"), y)
+            put(env, op.output("Mean"), mean)
+            put(env, op.output("Variance"), var)
+            return
+    axes = tuple(range(begin, x.ndim))
+    # stats in fp32 even for bf16-resident activations (AMP); Y stored in
+    # the input dtype so the residual stream stays bf16 (cf. batch_norm)
+    in_dtype = x.dtype
+    x = x.astype(jnp.float32) if x.dtype == jnp.bfloat16 else x
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    norm = (x - mean) * jax.lax.rsqrt(var + eps)
+    bshape = [1] * begin + list(x.shape[begin:])
+    if scale is not None:
+        norm = norm * scale.reshape(bshape)
+    if bias is not None:
+        norm = norm + bias.reshape(bshape)
+    put(env, op.output("Y"), norm.astype(in_dtype))
+    put(env, op.output("Mean"), mean.reshape(mean.shape[:begin]))
+    put(env, op.output("Variance"), var.reshape(var.shape[:begin]))
+
+
+@register("group_norm")
+def _group_norm(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    scale = get(env, op.input("Scale"))
+    bias = get(env, op.input("Bias"))
+    g = op.attr("groups")
+    eps = op.attr("epsilon", 1e-5)
+    n, c = x.shape[0], x.shape[1]
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    y = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    cshape = (1, c) + (1,) * (x.ndim - 2)
+    if scale is not None:
+        y = y * scale.reshape(cshape)
+    if bias is not None:
+        y = y + bias.reshape(cshape)
+    put(env, op.output("Y"), y)
+
+
+@register("lrn")
+def _lrn(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    n_size = op.attr("n", 5)
+    alpha = op.attr("alpha", 1e-4)
+    beta = op.attr("beta", 0.75)
+    k = op.attr("k", 1.0)
+    sq = jnp.square(x)
+    half = n_size // 2
+    pad = jnp.pad(sq, [(0, 0), (half, half), (0, 0), (0, 0)])
+    acc = sum(pad[:, i:i + x.shape[1]] for i in range(n_size))
+    put(env, op.output("Out"), x / jnp.power(k + alpha * acc, beta))
+
+
+# ---------------- dropout / softmax ----------------
+
+@register("dropout")
+def _dropout(env, op):
+    x = get(env, op.input("X"))
+    p = op.attr("dropout_prob", 0.5)
+    is_test = op.attr("is_test", False)
+    impl = op.attr("dropout_implementation", "downgrade_in_infer")
+    if is_test:
+        out = x * (1.0 - p) if impl == "downgrade_in_infer" else x
+        put(env, op.output("Out"), out)
+        return
+    # (A 16-bit threshold variant halving the RNG-bit volume was measured
+    # net-negative on transformer-base and only +1.5% on BERT — XLA's
+    # fused rbg + compare + select is already near its roofline here.)
+    keep = jax.random.bernoulli(next_rng(env), 1.0 - p, x.shape)
+    mask = keep.astype(x.dtype)
+    if impl == "upscale_in_train":
+        out = jnp.where(p >= 1.0, jnp.zeros_like(x), x * mask / (1.0 - p))
+    else:
+        out = x * mask
+    put(env, op.output("Out"), out)
+    put(env, op.output("Mask"), mask)
+
+
+@register("softmax")
+def _softmax(env, op):
+    x = get(env, op.input("X"))
+    out = jax.nn.softmax(x.astype(jnp.float32), axis=op.attr("axis", -1))
+    put(env, op.output("Out"), out.astype(x.dtype))
+
+
+@register("log_softmax")
+def _log_softmax(env, op):
+    x = get(env, op.input("X"))
+    out = jax.nn.log_softmax(x.astype(jnp.float32),
+                             axis=op.attr("axis", -1))
+    put(env, op.output("Out"), out.astype(x.dtype))
+
+
+# ---------------- losses ----------------
+
+@register("cross_entropy")
+def _cross_entropy(env, op):
+    """Ref ``cross_entropy_op.cc``: X is a probability distribution.
+    Hard label -> -log(p[label]); soft label -> -sum(label*log(p))."""
+    x = get(env, op.input("X"))
+    label = get(env, op.input("Label"))
+    eps = 1e-8
+    if op.attr("soft_label", False):
+        loss = -jnp.sum(label * jnp.log(x + eps), axis=-1, keepdims=True)
+    else:
+        ids = label.astype(jnp.int32)
+        if ids.ndim == x.ndim:
+            ids = ids.squeeze(-1)
+        p = jnp.take_along_axis(x, ids[..., None], axis=-1)
+        loss = -jnp.log(p + eps)
+        ignore = op.attr("ignore_index", -100)
+        loss = jnp.where(ids[..., None] == ignore, 0.0, loss)
+    put(env, op.output("Y"), loss)
+
+
+@register("softmax_with_cross_entropy")
+def _softmax_with_cross_entropy(env, op):
+    logits = get(env, op.input("Logits"))
+    label = get(env, op.input("Label"))
+    # fp32 softmax stats for bf16-resident logits (AMP)
+    log_p = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    if op.attr("soft_label", False):
+        loss = -jnp.sum(label * log_p, axis=-1, keepdims=True)
+    else:
+        ids = label.astype(jnp.int32)
+        if ids.ndim == logits.ndim:
+            ids = ids.squeeze(-1)
+        loss = -jnp.take_along_axis(log_p, ids[..., None], axis=-1)
+        ignore = op.attr("ignore_index", -100)
+        loss = jnp.where(ids[..., None] == ignore, 0.0, loss)
+    put(env, op.output("Loss"), loss)
+    put(env, op.output("Softmax"), jnp.exp(log_p))
+
+
+@register("smooth_softmax_ce")
+def _smooth_softmax_ce(env, op):
+    """Label-smoothed softmax CE in closed form:
+
+        loss = lse(logits) - (1-eps)*logits[y] - (eps/V)*sum(logits)
+
+    ≡ (1-eps)*CE(y) + eps*uniform-CE, but reads the [.., V] logits once and
+    writes only [..] per-token outputs — no [.., V] log-prob or soft-label
+    materialization (the reference pairs ``label_smooth_op.cc`` with
+    ``softmax_with_cross_entropy_op.cc``, building a full soft-label tensor).
+    eps=0 degrades to plain softmax CE. The backward (via autodiff) is
+    softmax(logits) - (1-eps)*onehot - eps/V: one more single pass."""
+    logits = get(env, op.input("Logits"))
+    ids = get(env, op.input("Label")).astype(jnp.int32)
+    if ids.ndim == logits.ndim:
+        ids = ids.squeeze(-1)
+    eps = op.attr("epsilon", 0.0)
+    # fp32 softmax stats regardless of (bf16) logits dtype; the convert
+    # fuses into the reduction's read pass
+    lf = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(lf, axis=-1)
+    logit_y = jnp.take_along_axis(lf, ids[..., None], axis=-1)[..., 0]
+    loss = lse - (1.0 - eps) * logit_y
+    if eps:
+        loss = loss - eps * jnp.mean(lf, axis=-1)
+    put(env, op.output("Loss"), loss)
+
+
+@register("fused_linear_smooth_ce")
+def _fused_linear_smooth_ce(env, op):
+    """Vocab projection + label-smoothed softmax CE in one kernel: the
+    [.., V] logits never reach HBM (Pallas online-softmax forward, chunked
+    recompute backward — ``ops/fused_ce.py``). Replaces the reference's
+    projection + ``softmax_with_cross_entropy_op.cc`` pairing for the big-
+    vocab loss heads."""
+    from ...ops.fused_ce import linear_smooth_ce
+    from ..op_registry import mxu_cast
+
+    x = get(env, op.input("X"))
+    w = get(env, op.input("W"))
+    b = get(env, op.input("Bias"))
+    ids = get(env, op.input("Label")).astype(jnp.int32)
+    if ids.ndim == x.ndim:
+        ids = ids.squeeze(-1)
+    x, w, b = mxu_cast(x, w, b)
+    put(env, op.output("Loss"), linear_smooth_ce(
+        x, w, b, ids, op.attr("epsilon", 0.0)))
+
+
+@register("sigmoid_cross_entropy_with_logits")
+def _sigmoid_ce(env, op):
+    x = get(env, op.input("X"))
+    label = get(env, op.input("Label"))
+    loss = jnp.maximum(x, 0.0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    ignore = op.attr("ignore_index", -100)
+    loss = jnp.where(label == ignore, 0.0, loss)
+    if op.attr("normalize", False):
+        denom = jnp.maximum(jnp.sum((label != ignore).astype(x.dtype)), 1.0)
+        loss = loss / denom
+    put(env, op.output("Out"), loss)
+
+
+@register("square_error_cost")
+def _square_error_cost(env, op):
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    put(env, op.output("Out"), jnp.square(x - y))
+
+
+@register("smooth_l1_loss")
+def _smooth_l1(env, op):
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    sigma = op.attr("sigma", 1.0)
+    s2 = sigma * sigma
+    diff = x - y
+    iw = get(env, op.input("InsideWeight"))
+    ow = get(env, op.input("OutsideWeight"))
+    if iw is not None:
+        diff = diff * iw
+    ad = jnp.abs(diff)
+    loss = jnp.where(ad < 1.0 / s2, 0.5 * s2 * diff * diff, ad - 0.5 / s2)
+    if ow is not None:
+        loss = loss * ow
+    put(env, op.output("Diff"), diff)
+    put(env, op.output("Out"), jnp.sum(loss, axis=tuple(range(1, x.ndim)), keepdims=False).reshape(x.shape[0], 1))
+
+
+@register("huber_loss")
+def _huber_loss(env, op):
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    delta = op.attr("delta", 1.0)
+    r = y - x
+    ar = jnp.abs(r)
+    loss = jnp.where(ar <= delta, 0.5 * r * r, delta * (ar - 0.5 * delta))
+    put(env, op.output("Residual"), r)
+    put(env, op.output("Out"), loss)
+
+
+@register("label_smooth")
+def _label_smooth(env, op):
+    x = get(env, op.input("X"))
+    eps = op.attr("epsilon", 0.0)
+    dist = get(env, op.input("PriorDist"))
+    k = x.shape[-1]
+    if dist is not None:
+        out = (1 - eps) * x + eps * dist
+    else:
+        out = (1 - eps) * x + eps / k
+    put(env, op.output("Out"), out)
+
+
+@register("kldiv_loss")
+def _kldiv_loss(env, op):
+    x = get(env, op.input("X"))  # log-probabilities
+    target = get(env, op.input("Target"))
+    loss = target * (jnp.log(jnp.maximum(target, 1e-12)) - x)
+    red = op.attr("reduction", "mean")
+    if red == "mean":
+        loss = jnp.mean(loss)
+    elif red == "sum":
+        loss = jnp.sum(loss)
+    elif red == "batchmean":
+        loss = jnp.sum(loss) / x.shape[0]
+    put(env, op.output("Loss"), loss)
+
+
+@register("bpr_loss")
+def _bpr_loss(env, op):
+    x = get(env, op.input("X"))
+    label = get(env, op.input("Label")).astype(jnp.int32)
+    if label.ndim == x.ndim:
+        label = label.squeeze(-1)
+    pos = jnp.take_along_axis(x, label[..., None], axis=-1)
+    diff = pos - x
+    loss = -jnp.log(jax.nn.sigmoid(diff) + 1e-8)
+    # exclude the positive column itself
+    mask = 1.0 - jax.nn.one_hot(label, x.shape[-1], dtype=x.dtype)
+    loss = jnp.sum(loss * mask, axis=-1, keepdims=True) / jnp.maximum(x.shape[-1] - 1, 1)
+    put(env, op.output("Y"), loss)
+
+
+@register("hinge_loss")
+def _hinge_loss(env, op):
+    logits = get(env, op.input("Logits"))
+    labels = get(env, op.input("Labels"))
+    put(env, op.output("Loss"),
+        jnp.maximum(1.0 - (2.0 * labels - 1.0) * logits, 0.0))
+
+
+@register("log_loss")
+def _log_loss(env, op):
+    p = get(env, op.input("Predicted"))
+    label = get(env, op.input("Labels"))
+    eps = op.attr("epsilon", 1e-4)
+    put(env, op.output("Loss"),
+        -label * jnp.log(p + eps) - (1 - label) * jnp.log(1 - p + eps))
+
+
+@register("margin_rank_loss")
+def _margin_rank_loss(env, op):
+    x1 = get(env, op.input("X1"))
+    x2 = get(env, op.input("X2"))
+    label = get(env, op.input("Label"))
+    margin = op.attr("margin", 0.0)
+    out = jnp.maximum(0.0, -label * (x1 - x2) + margin)
+    put(env, op.output("Out"), out)
+    put(env, op.output("Activated"), (out > 0).astype(x1.dtype))
+
+
+@register("mse_loss")
+def _mse_loss(env, op):
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    put(env, op.output("Out"), jnp.mean(jnp.square(x - y)))
+
+
+# ---------------- interpolation / resize ----------------
+
+@register("bilinear_interp", "nearest_interp")
+def _interp(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    out_h = op.attr("out_h")
+    out_w = op.attr("out_w")
+    scale = op.attr("scale", 0.0)
+    if scale and (not out_h or out_h <= 0):
+        out_h = int(x.shape[2] * scale)
+        out_w = int(x.shape[3] * scale)
+    method = "bilinear" if op.type == "bilinear_interp" else "nearest"
+    align = op.attr("align_corners", True)
+    if method == "bilinear" and align and out_h > 1 and out_w > 1:
+        # align_corners bilinear: explicit gather-based implementation
+        h_in, w_in = x.shape[2], x.shape[3]
+        ys = jnp.linspace(0.0, h_in - 1.0, out_h)
+        xs = jnp.linspace(0.0, w_in - 1.0, out_w)
+        y0 = jnp.floor(ys).astype(jnp.int32)
+        x0 = jnp.floor(xs).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, h_in - 1)
+        x1 = jnp.minimum(x0 + 1, w_in - 1)
+        wy = (ys - y0)[None, None, :, None]
+        wx = (xs - x0)[None, None, None, :]
+        g = lambda yy, xx: x[:, :, yy, :][:, :, :, xx]
+        out = (g(y0, x0) * (1 - wy) * (1 - wx) + g(y1, x0) * wy * (1 - wx)
+               + g(y0, x1) * (1 - wy) * wx + g(y1, x1) * wy * wx)
+    else:
+        out = jax.image.resize(x, x.shape[:2] + (out_h, out_w), method=method)
+    put(env, op.output("Out"), out.astype(x.dtype))
+
+
+# ---------------- misc nn ----------------
+
+@register("im2sequence")
+def _im2sequence(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    kernels = op.attr("kernels")
+    strides = op.attr("strides", [1, 1])
+    paddings = op.attr("paddings", [0, 0, 0, 0])
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0),
+                     (paddings[0], paddings[2]), (paddings[1], paddings[3])])
+    kh, kw = kernels
+    oh = (xp.shape[2] - kh) // strides[0] + 1
+    ow = (xp.shape[3] - kw) // strides[1] + 1
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, (kh, kw), tuple(strides), "VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    # patches: [N, C*kh*kw, oh, ow] -> [N*oh*ow, C*kh*kw]
+    out = patches.transpose(0, 2, 3, 1).reshape(n * oh * ow, c * kh * kw)
+    put(env, op.output("Out"), out)
+
+
+@register("grid_sampler")
+def _grid_sampler(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    grid = get(env, op.input("Grid"))  # NHW2 in [-1, 1]
+    n, c, h, w = x.shape
+    gx = (grid[..., 0] + 1) * (w - 1) / 2
+    gy = (grid[..., 1] + 1) * (h - 1) / 2
+    x0 = jnp.clip(jnp.floor(gx).astype(jnp.int32), 0, w - 1)
+    y0 = jnp.clip(jnp.floor(gy).astype(jnp.int32), 0, h - 1)
+    x1 = jnp.clip(x0 + 1, 0, w - 1)
+    y1 = jnp.clip(y0 + 1, 0, h - 1)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gat(yy, xx):
+        bidx = jnp.arange(n)[:, None, None]
+        return x[bidx, :, yy, xx]  # [N, Ho, Wo, C]
+
+    out = (gat(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+           + gat(y0, x1) * ((1 - wy) * wx)[..., None]
+           + gat(y1, x0) * (wy * (1 - wx))[..., None]
+           + gat(y1, x1) * (wy * wx)[..., None])
+    put(env, op.output("Output"), out.transpose(0, 3, 1, 2))
+
+
+@register("pixel_shuffle")
+def _pixel_shuffle(env, op):
+    x = get(env, op.input("X"))
+    r = op.attr("upscale_factor")
+    n, c, h, w = x.shape
+    out = x.reshape(n, c // (r * r), r, r, h, w)
+    out = out.transpose(0, 1, 4, 2, 5, 3).reshape(n, c // (r * r), h * r, w * r)
+    put(env, op.output("Out"), out)
+
+
+@register("moe_ffn")
+def _moe_ffn(env, op):
+    """Mixture-of-experts FFN (see ``parallel/moe.py``; new capability vs
+    the reference — SURVEY.md §2.5D lists expert parallelism as absent)."""
+    from ...parallel.moe import moe_ffn_apply
+
+    x = get(env, op.input("X"))
+    gate_w = get(env, op.input("GateW"))
+    w1 = get(env, op.input("W1"))
+    b1 = get(env, op.input("B1"))
+    w2 = get(env, op.input("W2"))
+    b2 = get(env, op.input("B2"))
+    act = {"relu": jax.nn.relu, "gelu": jax.nn.gelu}[op.attr("act", "relu")]
+    out, aux = moe_ffn_apply(
+        x, gate_w, w1, b1, w2, b2, k=op.attr("k", 2),
+        capacity_factor=op.attr("capacity_factor", 1.25), activation=act)
+    put(env, op.output("Out"), out)
+    put(env, op.output("AuxLoss"), aux)

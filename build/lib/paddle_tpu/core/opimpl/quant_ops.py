@@ -1,0 +1,78 @@
+"""Fake-quantization ops (QAT).
+
+Reference kernels: ``paddle/fluid/operators/fake_quantize_op.cc``
+(``fake_quantize_abs_max``, ``fake_quantize_moving_average_abs_max``,
+``fake_dequantize_max_abs``). Re-designed for XLA autodiff: the round/clip
+is wrapped in a straight-through estimator (``x + stop_grad(q(x) - x)``)
+instead of a hand-written identity-grad kernel, so the backward falls out
+of jax.grad and fuses with the surrounding graph.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put
+
+
+def _ste(x, q):
+    """Straight-through: forward q, gradient of identity."""
+    return x + jax.lax.stop_gradient(q - x)
+
+
+def _quant_dequant(x, scale, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-8)
+    q = jnp.round(jnp.clip(x / s, -1.0, 1.0) * qmax) / qmax * s
+    return q
+
+
+@register("fake_quantize_abs_max")
+def _fake_quantize_abs_max(env, op):
+    x = get(env, op.input("X"))
+    bits = op.attr("bit_length", 8)
+    scale = jnp.max(jnp.abs(x))
+    put(env, op.output("Out"), _ste(x, _quant_dequant(x, scale, bits)))
+    put(env, op.output("OutScale"), scale.reshape(()))
+
+
+@register("fake_quantize_moving_average_abs_max")
+def _fake_quantize_moving_avg(env, op):
+    """Activation quantization with a moving-average scale (state var), the
+    stable choice for activations whose range varies batch to batch."""
+    x = get(env, op.input("X"))
+    state = get(env, op.input("InScale")).reshape(())
+    bits = op.attr("bit_length", 8)
+    rate = op.attr("moving_rate", 0.9)
+    cur = jax.lax.stop_gradient(jnp.max(jnp.abs(x)))
+    if op.attr("is_test", False):
+        new_state = state
+    else:
+        # seed the EMA with the first batch's abs-max: an uncorrected EMA
+        # from the zero init would quantize early steps with a ~(1-rate)x
+        # too-small scale (ref keeps accum/state pairs for the same reason)
+        new_state = jnp.where(state > 0, rate * state + (1.0 - rate) * cur,
+                              cur)
+    scale = jnp.where(new_state > 0, new_state, cur)
+    put(env, op.output("Out"), _ste(x, _quant_dequant(x, scale, bits)))
+    put(env, op.output("OutScale"), new_state.reshape(()))
+
+
+@register("fake_channel_wise_quantize_abs_max")
+def _fake_channel_wise_quant(env, op):
+    """Per-output-channel weight quantization (axis 0 = OIHW / axis 1 for
+    mul weights is handled by the transpiler passing ``quant_axis``)."""
+    x = get(env, op.input("X"))
+    bits = op.attr("bit_length", 8)
+    axis = op.attr("quant_axis", 0)
+    red = tuple(i for i in range(x.ndim) if i != axis)
+    scale = jnp.max(jnp.abs(x), axis=red, keepdims=True)
+    put(env, op.output("Out"), _ste(x, _quant_dequant(x, scale, bits)))
+    put(env, op.output("OutScale"), scale.reshape(-1))
+
+
+@register("fake_dequantize_max_abs")
+def _fake_dequantize(env, op):
+    x = get(env, op.input("X"))
+    scale = get(env, op.input("Scale"))
+    qmax = float(2 ** (op.attr("bit_length", 8) - 1) - 1)
+    put(env, op.output("Out"), x.astype(jnp.float32) * scale / qmax)

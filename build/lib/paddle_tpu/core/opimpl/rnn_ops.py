@@ -1,0 +1,385 @@
+"""Recurrent ops: LSTM / GRU over padded batches with length masks.
+
+The reference handles variable-length sequences with LoD-packed batches and
+specialized kernels (``math/lstm_compute``, ``gru_op.cc``,
+``recurrent_op.cc``). On TPU the idiomatic form is static-shape padded
+[batch, time, ...] tensors + a length mask, scanned with ``lax.scan`` so XLA
+compiles ONE fused step function — the gate matmuls hit the MXU per step.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put
+
+
+def _mask_from_lengths(lengths, t_steps, dtype):
+    # [B] -> [T, B, 1] validity mask
+    t = jnp.arange(t_steps)[:, None]
+    return (t < lengths[None, :]).astype(dtype)[..., None]
+
+
+@register("lstm_seq")
+def _lstm_seq(env, op):
+    """Single-layer LSTM over [B, T, D] input.
+
+    Inputs: Input [B,T,4H] (pre-projected gates, like ref ``lstm_op`` taking
+    x@W as input), Weight [H,4H] recurrent weights, Bias [4H] (+peephole
+    [7H] unsupported -> first 4H used), Lengths [B] optional.
+    Gate order follows the reference: i, f, c(hat), o
+    (``operators/math/detail/lstm_kernel.h``)."""
+    xproj = get(env, op.input("Input"))  # [B, T, 4H]
+    w = get(env, op.input("Weight"))  # [H, 4H]
+    bias = get(env, op.input("Bias"))  # [1, 4H] or [4H]
+    lengths = get(env, op.input("Lengths"))
+    b_sz, t_sz, four_h = xproj.shape
+    h_sz = four_h // 4
+    is_reverse = op.attr("is_reverse", False)
+    if bias is not None:
+        bias = bias.reshape(-1)[: 4 * h_sz]
+
+    xs = jnp.swapaxes(xproj, 0, 1)  # [T, B, 4H]
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+    mask = None
+    if lengths is not None:
+        mask = _mask_from_lengths(lengths.reshape(-1), t_sz, xproj.dtype)
+        if is_reverse:
+            mask = jnp.flip(mask, axis=0)
+
+    h0 = get(env, op.input("H0"))
+    c0 = get(env, op.input("C0"))
+    h0 = jnp.zeros((b_sz, h_sz), xproj.dtype) if h0 is None \
+        else h0.astype(xproj.dtype)
+    c0 = jnp.zeros((b_sz, h_sz), xproj.dtype) if c0 is None \
+        else c0.astype(xproj.dtype)
+
+    def step(carry, inp):
+        h_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + h_prev @ w
+        if bias is not None:
+            gates = gates + bias
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        if m_t is not None:
+            h = h * m_t + h_prev * (1 - m_t)
+            c = c * m_t + c_prev * (1 - m_t)
+        return (h, c), (h, c)
+
+    if mask is None:
+        (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, jnp.ones((t_sz, b_sz, 1), xproj.dtype)))
+    else:
+        (_, _), (hs, cs) = jax.lax.scan(step, (h0, c0), (xs, mask))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    put(env, op.output("Hidden"), jnp.swapaxes(hs, 0, 1))  # [B, T, H]
+    put(env, op.output("Cell"), jnp.swapaxes(cs, 0, 1))
+
+
+@register("gru_seq")
+def _gru_seq(env, op):
+    """Single-layer GRU over [B, T, 3H] pre-projected input (ref ``gru_op``).
+    Gate order: update u, reset r, candidate c (``math/detail/gru_kernel.h``).
+    """
+    xproj = get(env, op.input("Input"))  # [B, T, 3H]
+    w = get(env, op.input("Weight"))  # [H, 3H]: [:, :2H] gates, [:, 2H:] candidate
+    bias = get(env, op.input("Bias"))
+    lengths = get(env, op.input("Lengths"))
+    b_sz, t_sz, three_h = xproj.shape
+    h_sz = three_h // 3
+    is_reverse = op.attr("is_reverse", False)
+    origin_mode = op.attr("origin_mode", False)
+    if bias is not None:
+        bias = bias.reshape(-1)
+
+    xs = jnp.swapaxes(xproj, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+    if lengths is not None:
+        mask = _mask_from_lengths(lengths.reshape(-1), t_sz, xproj.dtype)
+        if is_reverse:
+            mask = jnp.flip(mask, axis=0)
+    else:
+        mask = jnp.ones((t_sz, b_sz, 1), xproj.dtype)
+
+    w_g = w[:, : 2 * h_sz]
+    w_c = w[:, 2 * h_sz:]
+    h0 = jnp.zeros((b_sz, h_sz), xproj.dtype)
+
+    def step(h_prev, inp):
+        x_t, m_t = inp
+        xg = x_t[:, : 2 * h_sz]
+        xc = x_t[:, 2 * h_sz:]
+        if bias is not None:
+            xg = xg + bias[: 2 * h_sz]
+            xc = xc + bias[2 * h_sz:]
+        g = jax.nn.sigmoid(xg + h_prev @ w_g)
+        u, r = jnp.split(g, 2, axis=-1)
+        c = jnp.tanh(xc + (r * h_prev) @ w_c)
+        if origin_mode:
+            h = u * h_prev + (1 - u) * c
+        else:
+            h = (1 - u) * h_prev + u * c
+        h = h * m_t + h_prev * (1 - m_t)
+        return h, h
+
+    _, hs = jax.lax.scan(step, h0, (xs, mask))
+    if is_reverse:
+        hs = jnp.flip(hs, axis=0)
+    put(env, op.output("Hidden"), jnp.swapaxes(hs, 0, 1))
+
+
+@register("gru_unit")
+def _gru_unit(env, op):
+    """One GRU step (ref ``operators/gru_unit_op.cc``): Input [B,3H] is the
+    pre-projected x, HiddenPrev [B,H]; same gate order as gru_seq."""
+    x = get(env, op.input("Input"))
+    h_prev = get(env, op.input("HiddenPrev"))
+    w = get(env, op.input("Weight"))
+    bias = get(env, op.input("Bias"))
+    h_sz = h_prev.shape[-1]
+    origin_mode = op.attr("origin_mode", False)
+    xg = x[:, : 2 * h_sz]
+    xc = x[:, 2 * h_sz:]
+    if bias is not None:
+        bias = bias.reshape(-1)
+        xg = xg + bias[: 2 * h_sz]
+        xc = xc + bias[2 * h_sz:]
+    g = jax.nn.sigmoid(xg + h_prev @ w[:, : 2 * h_sz])
+    u, r = jnp.split(g, 2, axis=-1)
+    c = jnp.tanh(xc + (r * h_prev) @ w[:, 2 * h_sz:])
+    if origin_mode:
+        h = u * h_prev + (1 - u) * c
+    else:
+        h = (1 - u) * h_prev + u * c
+    put(env, op.output("Hidden"), h)
+
+
+@register("lstmp_seq")
+def _lstmp_seq(env, op):
+    """Projection LSTM (ref ``lstmp_op.cc``): the recurrent state is the
+    PROJECTED hidden r = proj_act(h @ ProjWeight) of size P < H, so the
+    recurrent matmul is [P, 4H]. Inputs: Input [B,T,4H] (pre-projected
+    gates), Weight [P,4H], ProjWeight [H,P], Bias [4H]; outputs
+    Projection [B,T,P] and Cell [B,T,H]. cell_clip/proj_clip per the
+    reference attrs; gate order i,f,c,o."""
+    xproj = get(env, op.input("Input"))   # [B, T, 4H]
+    w = get(env, op.input("Weight"))      # [P, 4H]
+    wproj = get(env, op.input("ProjWeight"))  # [H, P]
+    bias = get(env, op.input("Bias"))
+    lengths = get(env, op.input("Lengths"))
+    b_sz, t_sz, four_h = xproj.shape
+    h_sz = four_h // 4
+    p_sz = wproj.shape[1]
+    is_reverse = op.attr("is_reverse", False)
+    cell_clip = op.attr("cell_clip", 0.0)
+    proj_clip = op.attr("proj_clip", 0.0)
+    proj_act = op.attr("proj_activation", "tanh")
+    if bias is not None:
+        bias = bias.reshape(-1)[:4 * h_sz]
+
+    xs = jnp.swapaxes(xproj, 0, 1)
+    if is_reverse:
+        xs = jnp.flip(xs, axis=0)
+    if lengths is not None:
+        mask = _mask_from_lengths(lengths.reshape(-1), t_sz, xproj.dtype)
+        if is_reverse:
+            mask = jnp.flip(mask, axis=0)
+    else:
+        mask = jnp.ones((t_sz, b_sz, 1), xproj.dtype)
+
+    def pact(v):
+        if proj_act == "identity":
+            return v
+        return getattr(jnp, proj_act, jnp.tanh)(v)
+
+    r0 = jnp.zeros((b_sz, p_sz), xproj.dtype)
+    c0v = get(env, op.input("C0"))
+    c0 = jnp.zeros((b_sz, h_sz), xproj.dtype) if c0v is None \
+        else c0v.astype(xproj.dtype)
+    h0v = get(env, op.input("H0"))
+    if h0v is not None:  # H0 holds the initial PROJECTION in lstmp
+        r0 = h0v.astype(xproj.dtype)
+
+    def step(carry, inp):
+        r_prev, c_prev = carry
+        x_t, m_t = inp
+        gates = x_t + r_prev @ w
+        if bias is not None:
+            gates = gates + bias
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        if cell_clip > 0:
+            c = jnp.clip(c, -cell_clip, cell_clip)
+        h = o * jnp.tanh(c)
+        r = pact(h @ wproj)
+        if proj_clip > 0:
+            r = jnp.clip(r, -proj_clip, proj_clip)
+        r = r * m_t + r_prev * (1 - m_t)
+        c = c * m_t + c_prev * (1 - m_t)
+        return (r, c), (r, c)
+
+    _, (rs, cs) = jax.lax.scan(step, (r0, c0), (xs, mask))
+    if is_reverse:
+        rs = jnp.flip(rs, axis=0)
+        cs = jnp.flip(cs, axis=0)
+    put(env, op.output("Projection"), jnp.swapaxes(rs, 0, 1))
+    put(env, op.output("Cell"), jnp.swapaxes(cs, 0, 1))
+
+
+@register("attention_lstm")
+def _attention_lstm(env, op):
+    """Ref ``attention_lstm_op.cc``: per step, attend over the WHOLE
+    input sequence using c_{t-1} —
+      fc1 = relu(concat(x, expand(c_prev)) @ AttentionWeight + b)
+      fc2 = relu(fc1 * scalar + scalar_bias); a = softmax_T(fc2)
+      lstm_x = sum_t a_t * x_t
+    then one LSTM step on concat(lstm_x, h_prev) @ LSTMWeight.
+    Padded re-design: X [B, T, M] + Lengths; outputs Hidden/Cell
+    [B, T, D]."""
+    x = get(env, op.input("X"))            # [B, T, M]
+    aw = get(env, op.input("AttentionWeight"))      # [M+D, 1]
+    ab = get(env, op.input("AttentionBias"))        # [1] or None
+    asc = get(env, op.input("AttentionScalar"))     # [1] or None
+    asb = get(env, op.input("AttentionScalarBias"))  # [1] or None
+    lw = get(env, op.input("LSTMWeight"))  # [M+D, 4D]
+    lb = get(env, op.input("LSTMBias"))    # [4D]
+    lengths = get(env, op.input("Lengths"))
+    b_sz, t_sz, m_sz = x.shape
+    d_sz = lw.shape[1] // 4
+
+    if lengths is not None:
+        valid = (jnp.arange(t_sz)[None, :]
+                 < lengths.reshape(-1)[:, None])  # [B, T]
+    else:
+        valid = jnp.ones((b_sz, t_sz), bool)
+
+    h0v = get(env, op.input("H0"))
+    c0v = get(env, op.input("C0"))
+    h0 = jnp.zeros((b_sz, d_sz), x.dtype) if h0v is None \
+        else h0v.astype(x.dtype)
+    c0 = jnp.zeros((b_sz, d_sz), x.dtype) if c0v is None \
+        else c0v.astype(x.dtype)
+
+    aw_x, aw_c = aw[:m_sz], aw[m_sz:]      # split the concat projection
+
+    def step(carry, m_t):
+        h_prev, c_prev = carry
+        fc = x @ aw_x + (c_prev @ aw_c)[:, None, :]  # [B, T, 1]
+        if ab is not None:
+            fc = fc + ab.reshape(-1)[0]
+        fc = jax.nn.relu(fc)
+        if asc is not None:
+            fc = fc * asc.reshape(-1)[0]
+            if asb is not None:
+                fc = fc + asb.reshape(-1)[0]
+            fc = jax.nn.relu(fc)
+        score = jnp.where(valid[..., None], fc, -jnp.inf)
+        a = jax.nn.softmax(score, axis=1)
+        lstm_x = jnp.sum(a * x, axis=1)    # [B, M]
+        gates = jnp.concatenate([lstm_x, h_prev], axis=-1) @ lw
+        if lb is not None:
+            gates = gates + lb.reshape(-1)[:4 * d_sz]
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        h = h * m_t + h_prev * (1 - m_t)
+        c = c * m_t + c_prev * (1 - m_t)
+        return (h, c), (h, c)
+
+    mask = _mask_from_lengths(
+        lengths.reshape(-1) if lengths is not None
+        else jnp.full((b_sz,), t_sz), t_sz, x.dtype)
+    _, (hs, cs) = jax.lax.scan(step, (h0, c0), mask)
+    put(env, op.output("Hidden"), jnp.swapaxes(hs, 0, 1))
+    put(env, op.output("Cell"), jnp.swapaxes(cs, 0, 1))
+
+
+@register("tree_conv")
+def _tree_conv(env, op):
+    """Ref ``tree_conv_op.cc`` + ``math/tree2col.cc`` (TBCNN,
+    arxiv 1409.5718): continuous-binary-tree convolution. For each root,
+    descendants up to ``max_depth`` contribute eta_t/eta_l/eta_r-weighted
+    features; the three filter slots mix them.
+
+    Static re-design: EdgeSet [B, E, 2] (1-indexed parent->child, 0 pad),
+    NodesVector [B, N, F], Filter [F, 3, O, K] -> Out [B, N, O, K]
+    reshaped to the reference's [B, N, O*K]? No — [B, N, O, K] flattened
+    on the last two dims to match ``Out`` [N, output_size, num_filters].
+    Depth masks come from boolean adjacency powers (bounded by
+    max_depth), so the whole op stays jit-compatible."""
+    nodes = get(env, op.input("NodesVector"))  # [B, N, F]
+    edges = get(env, op.input("EdgeSet")).astype(jnp.int32)  # [B, E, 2]
+    filt = get(env, op.input("Filter"))        # [F, 3, O, K]
+    max_depth = int(op.attr("max_depth", 2))
+    squeeze_batch = nodes.ndim == 2
+    if squeeze_batch:
+        nodes = nodes[None]
+        edges = edges[None]
+    b, n, fdim = nodes.shape
+
+    def one(feat, es):
+        # adjacency (1-indexed nodes -> 0-indexed), invalid edges dropped
+        ok = (es[:, 0] > 0) & (es[:, 1] > 0)
+        pu = jnp.where(ok, es[:, 0] - 1, n)
+        pv = jnp.where(ok, es[:, 1] - 1, n)
+        adj = jnp.zeros((n + 1, n + 1), bool).at[pu, pv].set(ok)[:n, :n]
+        # per-node sibling index (1-based, by edge order) and sibling count
+        eidx = jnp.arange(es.shape[0])
+        order = jnp.where(ok, eidx, es.shape[0])
+        # rank of each edge among edges sharing the same parent
+        same_parent = (pu[None, :] == pu[:, None]) & ok[None, :] & ok[:, None]
+        rank = jnp.sum(same_parent & (order[None, :] < order[:, None]),
+                       axis=1)
+        child_cnt = jnp.sum(adj, axis=1)          # [n] children per node
+        idx1 = jnp.ones((n,), jnp.float32).at[pv].set(
+            jnp.where(ok, rank + 1.0, 1.0), mode="drop")
+        pclen = jnp.ones((n,), jnp.float32).at[pv].set(
+            jnp.where(ok, child_cnt[jnp.clip(pu, 0, n - 1)]
+                      .astype(jnp.float32), 1.0), mode="drop")
+
+        md = float(max_depth)
+        # depth-d reachability: reach[0] = I; reach[d] = reach[d-1] @ adj
+        acc = jnp.zeros((n, n, 3), jnp.float32)
+        reach = jnp.eye(n, dtype=bool)
+        seen = jnp.eye(n, dtype=bool)
+        for d in range(max_depth):
+            eta_t = (md - d) / md
+            if d == 0:
+                temp = jnp.full((n,), 0.5)  # root: index=1, pclen=1
+            else:
+                temp = jnp.where(pclen == 1.0, 0.5,
+                                 (idx1 - 1.0) / jnp.maximum(pclen - 1.0,
+                                                            1.0))
+            eta_l = (1.0 - eta_t) * temp
+            eta_r = (1.0 - eta_t) * (1.0 - temp)
+            wts = jnp.stack([jnp.full((n,), eta_t), eta_l, eta_r],
+                            axis=-1)  # [n, 3]
+            acc = acc + reach[:, :, None].astype(jnp.float32) \
+                * wts[None, :, :]
+            nxt = (reach @ adj) & ~seen  # next depth level, no revisits
+            seen = seen | nxt
+            reach = nxt
+        # patch[u, s, f] = sum_v acc[u, v, s] * feat[v, f]
+        patch = jnp.einsum("uvs,vf->usf", acc, feat)
+        return jnp.einsum("usf,fsok->uok", patch, filt)
+
+    out = jax.vmap(one)(nodes, edges)
+    if squeeze_batch:
+        out = out[0]
+    put(env, op.output("Out"), out)

@@ -1,0 +1,174 @@
+"""Sequence ops over padded batches with explicit lengths.
+
+The reference stores ragged batches as LoD-packed tensors and has ~15
+dedicated kernels (``paddle/fluid/operators/sequence_ops/``). XLA needs
+static shapes, so the TPU-native data contract is: dense [B, T, ...] padded
+tensors + a Length [B] companion (or a mask). Every sequence op here takes
+that contract; the data pipeline produces it (``data/feeder.py`` pads).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from ..op_registry import register, get, put
+
+
+def _mask(lengths, t, dtype):
+    return (jnp.arange(t)[None, :] < lengths.reshape(-1, 1)).astype(dtype)
+
+
+@register("sequence_mask")
+def _sequence_mask(env, op):
+    x = get(env, op.input("X")).reshape(-1)
+    maxlen = op.attr("maxlen", -1)
+    if maxlen is None or maxlen <= 0:
+        maxlen = op.output("Y").shape[-1]
+    from ..framework import convert_np_dtype
+    dtype = jnp.dtype(convert_np_dtype(op.attr("out_dtype", "int64")))
+    put(env, op.output("Y"), _mask(x, maxlen, dtype))
+
+
+@register("sequence_pool")
+def _sequence_pool(env, op):
+    x = get(env, op.input("X"))  # [B, T, D]
+    lengths = get(env, op.input("Lengths"))
+    ptype = op.attr("pooltype", "AVERAGE").upper()
+    t = x.shape[1]
+    if lengths is None:
+        m = jnp.ones(x.shape[:2], x.dtype)
+    else:
+        m = _mask(lengths.reshape(-1), t, x.dtype)
+    m3 = m[..., None]
+    if ptype == "SUM":
+        out = jnp.sum(x * m3, axis=1)
+    elif ptype == "AVERAGE":
+        out = jnp.sum(x * m3, axis=1) / jnp.maximum(jnp.sum(m3, axis=1), 1.0)
+    elif ptype == "SQRT":
+        out = jnp.sum(x * m3, axis=1) / jnp.sqrt(jnp.maximum(jnp.sum(m3, axis=1), 1.0))
+    elif ptype == "MAX":
+        neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+        out = jnp.max(jnp.where(m3 > 0, x, neg), axis=1)
+    elif ptype == "LAST":
+        if lengths is None:
+            out = x[:, -1]
+        else:
+            idx = jnp.maximum(lengths.reshape(-1).astype(jnp.int32) - 1, 0)
+            out = jnp.take_along_axis(x, idx[:, None, None].astype(jnp.int32), axis=1).squeeze(1)
+    elif ptype == "FIRST":
+        out = x[:, 0]
+    else:
+        raise NotImplementedError(ptype)
+    put(env, op.output("Out"), out)
+
+
+@register("sequence_softmax")
+def _sequence_softmax(env, op):
+    x = get(env, op.input("X"))  # [B, T]
+    lengths = get(env, op.input("Lengths"))
+    if lengths is None:
+        put(env, op.output("Out"), jax.nn.softmax(x, axis=-1))
+        return
+    m = _mask(lengths.reshape(-1), x.shape[1], x.dtype)
+    neg = jnp.finfo(x.dtype).min
+    out = jax.nn.softmax(jnp.where(m > 0, x, neg), axis=-1) * m
+    put(env, op.output("Out"), out)
+
+
+@register("sequence_reverse")
+def _sequence_reverse(env, op):
+    x = get(env, op.input("X"))  # [B, T, ...]
+    lengths = get(env, op.input("Lengths"))
+    t = x.shape[1]
+    if lengths is None:
+        put(env, op.output("Y"), jnp.flip(x, axis=1))
+        return
+    lens = lengths.reshape(-1, 1).astype(jnp.int32)
+    pos = jnp.arange(t)[None, :]
+    src = jnp.where(pos < lens, lens - 1 - pos, pos)
+    idx_shape = (x.shape[0], t) + (1,) * (x.ndim - 2)
+    put(env, op.output("Y"),
+        jnp.take_along_axis(x, src.reshape(idx_shape).astype(jnp.int32), axis=1))
+
+
+@register("sequence_expand")
+def _sequence_expand(env, op):
+    # ref sequence_expand: tile x rows per target lengths. With padded batch
+    # semantics this is a broadcast along a new time axis.
+    x = get(env, op.input("X"))
+    y = get(env, op.input("Y"))
+    t = y.shape[1]
+    put(env, op.output("Out"), jnp.repeat(x[:, None], t, axis=1))
+
+
+@register("sequence_conv")
+def _sequence_conv(env, op):
+    """Context-window conv over time (ref ``sequence_conv_op``): for each t,
+    concat rows [t+start, t+start+len) then project. Lowered to a gather +
+    one MXU matmul."""
+    x = get(env, op.input("X"))  # [B, T, D]
+    w = get(env, op.input("Filter"))  # [ctx_len*D, M]
+    ctx_len = op.attr("contextLength")
+    ctx_start = op.attr("contextStart", -((ctx_len - 1) // 2))
+    b, t, d = x.shape
+    cols = []
+    for i in range(ctx_len):
+        off = ctx_start + i
+        shifted = jnp.roll(x, -off, axis=1)
+        pos = jnp.arange(t) + off
+        valid = ((pos >= 0) & (pos < t))[None, :, None]
+        cols.append(jnp.where(valid, shifted, 0.0))
+    ctx = jnp.concatenate(cols, axis=-1)  # [B, T, ctx_len*D]
+    put(env, op.output("Out"), ctx @ w)
+
+
+@register("sequence_concat")
+def _sequence_concat(env, op):
+    xs = [get(env, v) for v in op.input_list("X")]
+    put(env, op.output("Out"), jnp.concatenate(xs, axis=1))
+
+
+@register("sequence_slice")
+def _sequence_slice(env, op):
+    x = get(env, op.input("X"))
+    offset = get(env, op.input("Offset")).reshape(-1)[0].astype(jnp.int32)
+    length = op.attr("length")
+    put(env, op.output("Out"),
+        jax.lax.dynamic_slice_in_dim(x, offset, length, axis=1))
+
+
+@register("sequence_pad")
+def _sequence_pad(env, op):
+    # with dense+lengths contract the input is already padded; normalize len
+    x = get(env, op.input("X"))
+    put(env, op.output("Out"), x)
+    lengths = get(env, op.input("Lengths"))
+    if lengths is not None:
+        put(env, op.output("Length"), lengths)
+
+
+@register("sequence_unpad")
+def _sequence_unpad(env, op):
+    put(env, op.output("Out"), get(env, op.input("X")))
+
+
+@register("sequence_enumerate")
+def _sequence_enumerate(env, op):
+    x = get(env, op.input("X"))  # [B, T] int ids
+    win = op.attr("win_size")
+    pad = op.attr("pad_value", 0)
+    b, t = x.shape[:2]
+    outs = []
+    for i in range(win):
+        shifted = jnp.roll(x, -i, axis=1)
+        valid = (jnp.arange(t) + i < t)[None, :]
+        outs.append(jnp.where(valid, shifted, pad))
+    put(env, op.output("Out"), jnp.stack(outs, axis=-1))
+
+
+@register("sequence_erase")
+def _sequence_erase(env, op):
+    # Static shapes can't drop tokens; replace with 0 and keep mask parity.
+    x = get(env, op.input("X"))
+    tokens = jnp.asarray(op.attr("tokens"))
+    hit = jnp.isin(x, tokens)
+    put(env, op.output("Out"), jnp.where(hit, 0, x))

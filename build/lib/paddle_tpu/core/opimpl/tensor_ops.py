@@ -1,0 +1,361 @@
+"""Tensor creation / manipulation / random / embedding ops.
+
+Reference: ``paddle/fluid/operators/`` (fill_constant, uniform/gaussian
+random, reshape, transpose, concat, split, slice, gather, scatter, expand,
+lookup_table, one_hot, cast, ...). Random ops draw from the executor's
+threaded PRNG key — functional randomness, the jax replacement for the
+reference's per-device curand generators.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..op_registry import register, get, get_list, put, next_rng
+from ..framework import convert_np_dtype
+
+
+@register("fill_constant")
+def _fill_constant(env, op):
+    shape = op.attr("shape")
+    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    value = op.attr("value", 0.0)
+    put(env, op.output("Out"), jnp.full(tuple(shape), value, dtype=dtype))
+
+
+@register("fill_constant_batch_size_like")
+def _fill_constant_batch_size_like(env, op):
+    ref = get(env, op.input("Input"))
+    shape = list(op.attr("shape"))
+    in_idx = op.attr("input_dim_idx", 0)
+    out_idx = op.attr("output_dim_idx", 0)
+    shape[out_idx] = ref.shape[in_idx]
+    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    put(env, op.output("Out"), jnp.full(tuple(shape), op.attr("value", 0.0), dtype=dtype))
+
+
+@register("fill_zeros_like")
+def _fill_zeros_like(env, op):
+    put(env, op.output("Out"), jnp.zeros_like(get(env, op.input("X"))))
+
+
+@register("uniform_random")
+def _uniform_random(env, op):
+    shape = tuple(op.attr("shape"))
+    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    lo, hi = op.attr("min", -1.0), op.attr("max", 1.0)
+    put(env, op.output("Out"),
+        jax.random.uniform(next_rng(env), shape, dtype=jnp.dtype(dtype),
+                           minval=lo, maxval=hi))
+
+
+@register("uniform_random_batch_size_like")
+def _uniform_random_batch_size_like(env, op):
+    ref = get(env, op.input("Input"))
+    shape = list(op.attr("shape"))
+    shape[op.attr("output_dim_idx", 0)] = ref.shape[op.attr("input_dim_idx", 0)]
+    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    put(env, op.output("Out"),
+        jax.random.uniform(next_rng(env), tuple(shape), dtype=jnp.dtype(dtype),
+                           minval=op.attr("min", -1.0), maxval=op.attr("max", 1.0)))
+
+
+@register("gaussian_random_batch_size_like")
+def _gaussian_random_batch_size_like(env, op):
+    ref = get(env, op.input("Input"))
+    shape = list(op.attr("shape"))
+    shape[op.attr("output_dim_idx", 0)] = ref.shape[op.attr("input_dim_idx", 0)]
+    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    mean, std = op.attr("mean", 0.0), op.attr("std", 1.0)
+    put(env, op.output("Out"),
+        mean + std * jax.random.normal(next_rng(env), tuple(shape),
+                                       dtype=jnp.dtype(dtype)))
+
+
+@register("gaussian_random")
+def _gaussian_random(env, op):
+    shape = tuple(op.attr("shape"))
+    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    mean, std = op.attr("mean", 0.0), op.attr("std", 1.0)
+    put(env, op.output("Out"),
+        mean + std * jax.random.normal(next_rng(env), shape, dtype=jnp.dtype(dtype)))
+
+
+@register("truncated_gaussian_random")
+def _truncated_gaussian_random(env, op):
+    shape = tuple(op.attr("shape"))
+    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    mean, std = op.attr("mean", 0.0), op.attr("std", 1.0)
+    put(env, op.output("Out"),
+        mean + std * jax.random.truncated_normal(
+            next_rng(env), -2.0, 2.0, shape, dtype=jnp.dtype(dtype)))
+
+
+@register("randint")
+def _randint(env, op):
+    shape = tuple(op.attr("shape"))
+    put(env, op.output("Out"),
+        jax.random.randint(next_rng(env), shape, op.attr("low", 0), op.attr("high"),
+                           dtype=jnp.int64))
+
+
+@register("assign")
+def _assign(env, op):
+    put(env, op.output("Out"), get(env, op.input("X")))
+
+
+@register("assign_value")
+def _assign_value(env, op):
+    vals = np.array(op.attr("values"),
+                    dtype=convert_np_dtype(op.attr("dtype", "float32")))
+    put(env, op.output("Out"), jnp.asarray(vals.reshape(op.attr("shape"))))
+
+
+@register("cast")
+def _cast(env, op):
+    dtype = convert_np_dtype(op.attr("out_dtype"))
+    put(env, op.output("Out"), get(env, op.input("X")).astype(jnp.dtype(dtype)))
+
+
+@register("concat")
+def _concat(env, op):
+    xs = get_list(env, op, "X")
+    put(env, op.output("Out"), jnp.concatenate(xs, axis=op.attr("axis", 0)))
+
+
+@register("split")
+def _split(env, op):
+    x = get(env, op.input("X"))
+    axis = op.attr("axis", 0)
+    num = op.attr("num", 0)
+    sections = op.attr("sections")
+    if sections:
+        idx = np.cumsum(sections[:-1]).tolist()
+        outs = jnp.split(x, idx, axis=axis)
+    else:
+        outs = jnp.split(x, num, axis=axis)
+    for v, o in zip(op.output_list("Out"), outs):
+        put(env, v, o)
+
+
+@register("reshape", "reshape2")
+def _reshape(env, op):
+    x = get(env, op.input("X"))
+    shape = list(op.attr("shape"))
+    # ref reshape_op: 0 means copy input dim, -1 inferred
+    for i, s in enumerate(shape):
+        if s == 0:
+            shape[i] = x.shape[i]
+    put(env, op.output("Out"), jnp.reshape(x, shape))
+
+
+@register("squeeze", "squeeze2")
+def _squeeze(env, op):
+    x = get(env, op.input("X"))
+    axes = op.attr("axes", [])
+    if axes:
+        axes = tuple(a if a >= 0 else a + x.ndim for a in axes)
+        out = x
+        for a in sorted(axes, reverse=True):
+            out = jnp.squeeze(out, axis=a)
+    else:
+        out = jnp.squeeze(x)
+    put(env, op.output("Out"), out)
+
+
+@register("unsqueeze", "unsqueeze2")
+def _unsqueeze(env, op):
+    x = get(env, op.input("X"))
+    out = x
+    for a in sorted(op.attr("axes")):
+        out = jnp.expand_dims(out, axis=a)
+    put(env, op.output("Out"), out)
+
+
+@register("flatten", "flatten2")
+def _flatten(env, op):
+    x = get(env, op.input("X"))
+    axis = op.attr("axis", 1)
+    lead = int(np.prod(x.shape[:axis])) if axis > 0 else 1
+    put(env, op.output("Out"), x.reshape((lead, -1)))
+
+
+@register("transpose", "transpose2")
+def _transpose(env, op):
+    put(env, op.output("Out"),
+        jnp.transpose(get(env, op.input("X")), axes=op.attr("axis")))
+
+
+@register("slice")
+def _slice(env, op):
+    x = get(env, op.input("Input"))
+    axes = op.attr("axes")
+    starts = op.attr("starts")
+    ends = op.attr("ends")
+    idx = [slice(None)] * x.ndim
+    for a, s, e in zip(axes, starts, ends):
+        dim = x.shape[a]
+        s = max(s + dim, 0) if s < 0 else min(s, dim)
+        e = max(e + dim, 0) if e < 0 else min(e, dim)
+        idx[a] = slice(s, e)
+    put(env, op.output("Out"), x[tuple(idx)])
+
+
+@register("strided_slice")
+def _strided_slice(env, op):
+    x = get(env, op.input("Input"))
+    idx = [slice(None)] * x.ndim
+    for a, s, e, st in zip(op.attr("axes"), op.attr("starts"),
+                           op.attr("ends"), op.attr("strides")):
+        idx[a] = slice(s, e, st)
+    put(env, op.output("Out"), x[tuple(idx)])
+
+
+@register("gather")
+def _gather(env, op):
+    x = get(env, op.input("X"))
+    idx = get(env, op.input("Index")).astype(jnp.int32)
+    put(env, op.output("Out"), jnp.take(x, idx.reshape(-1), axis=0))
+
+
+@register("gather_nd")
+def _gather_nd(env, op):
+    x = get(env, op.input("X"))
+    idx = get(env, op.input("Index")).astype(jnp.int32)
+    put(env, op.output("Out"), x[tuple(jnp.moveaxis(idx, -1, 0))])
+
+
+@register("scatter")
+def _scatter(env, op):
+    x = get(env, op.input("X"))
+    idx = get(env, op.input("Ids")).astype(jnp.int32).reshape(-1)
+    upd = get(env, op.input("Updates"))
+    if op.attr("overwrite", True):
+        out = x.at[idx].set(upd)
+    else:
+        out = x.at[idx].add(upd)
+    put(env, op.output("Out"), out)
+
+
+@register("expand")
+def _expand(env, op):
+    x = get(env, op.input("X"))
+    times = op.attr("expand_times")
+    put(env, op.output("Out"), jnp.tile(x, times))
+
+
+@register("expand_as")
+def _expand_as(env, op):
+    x = get(env, op.input("X"))
+    target = get(env, op.input("target_tensor"))
+    put(env, op.output("Out"), jnp.broadcast_to(x, target.shape))
+
+
+@register("stack")
+def _stack(env, op):
+    xs = get_list(env, op, "X")
+    put(env, op.output("Y"), jnp.stack(xs, axis=op.attr("axis", 0)))
+
+
+@register("unstack")
+def _unstack(env, op):
+    x = get(env, op.input("X"))
+    axis = op.attr("axis", 0)
+    outs = [jnp.squeeze(s, axis) for s in jnp.split(x, x.shape[axis], axis)]
+    for v, o in zip(op.output_list("Y"), outs):
+        put(env, v, o)
+
+
+@register("range")
+def _range(env, op):
+    start = get(env, op.input("Start")).reshape(())
+    end = get(env, op.input("End")).reshape(())
+    step = get(env, op.input("Step")).reshape(())
+    # shapes must be static under jit: range length from var metadata
+    n = op.output("Out").shape[0]
+    put(env, op.output("Out"), start + step * jnp.arange(n, dtype=start.dtype))
+
+
+@register("shape")
+def _shape(env, op):
+    x = get(env, op.input("Input"))
+    put(env, op.output("Out"), jnp.asarray(x.shape, dtype=jnp.int32))
+
+
+@register("lookup_table")
+def _lookup_table(env, op):
+    """Embedding lookup (ref ``lookup_table_op.cc``). padding_idx rows give
+    zeros. Sparse-grad (SelectedRows) is realized by XLA's gather-vjp
+    (scatter-add) — see optimizer sparse paths for the update side."""
+    w = get(env, op.input("W"))
+    ids = get(env, op.input("Ids")).astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    padding_idx = op.attr("padding_idx", -1)
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    from ..op_registry import amp_out_cast
+    put(env, op.output("Out"), amp_out_cast(out))
+
+
+@register("one_hot")
+def _one_hot(env, op):
+    ids = get(env, op.input("X")).astype(jnp.int32)
+    depth = op.attr("depth")
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    put(env, op.output("Out"), jax.nn.one_hot(ids, depth, dtype=jnp.float32))
+
+
+@register("pad")
+def _pad(env, op):
+    x = get(env, op.input("X"))
+    paddings = op.attr("paddings")  # flat [before0, after0, before1, ...]
+    pads = [(paddings[2 * i], paddings[2 * i + 1]) for i in range(x.ndim)]
+    put(env, op.output("Out"),
+        jnp.pad(x, pads, constant_values=op.attr("pad_value", 0.0)))
+
+
+@register("pad2d")
+def _pad2d(env, op):
+    x = get(env, op.input("X"))  # NCHW
+    p = op.attr("paddings")  # [top, bottom, left, right]
+    mode = op.attr("mode", "constant")
+    pads = [(0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])]
+    if mode == "constant":
+        out = jnp.pad(x, pads, constant_values=op.attr("pad_value", 0.0))
+    elif mode == "reflect":
+        out = jnp.pad(x, pads, mode="reflect")
+    else:
+        out = jnp.pad(x, pads, mode="edge")
+    put(env, op.output("Out"), out)
+
+
+@register("reverse")
+def _reverse(env, op):
+    x = get(env, op.input("X"))
+    out = x
+    for a in op.attr("axis"):
+        out = jnp.flip(out, axis=a)
+    put(env, op.output("Out"), out)
+
+
+@register("roll")
+def _roll(env, op):
+    put(env, op.output("Out"),
+        jnp.roll(get(env, op.input("X")), op.attr("shifts"), op.attr("axis")))
+
+
+@register("where")
+def _where(env, op):
+    put(env, op.output("Out"),
+        jnp.where(get(env, op.input("Condition")),
+                  get(env, op.input("X")), get(env, op.input("Y"))))
+
+
+@register("increment")
+def _increment(env, op):
+    x = get(env, op.input("X"))
+    put(env, op.output("Out"), x + op.attr("step", 1.0))

@@ -1,0 +1,16 @@
+"""Data pipeline: feeder, reader decorators, datasets, chunked record IO.
+
+Reference: ``python/paddle/fluid/data_feeder.py``, ``reader/decorator.py``,
+``python/paddle/dataset/``, ``recordio/`` + reader ops
+(``operators/reader/``). The double-buffer device-prefetch capability is a
+host-side background thread overlapping next-batch H2D with the current
+step (see ``py_reader``)."""
+
+from . import feeder  # noqa: F401
+from . import reader  # noqa: F401
+from . import datasets  # noqa: F401
+from .feeder import DataFeeder  # noqa: F401
+from .reader import (  # noqa: F401
+    shuffle, batch, buffered, map_readers, chain, compose, firstn, cache,
+    xmap_readers, multiprocess_reader, recordio_reader, recordio_writer)
+from .py_reader import py_reader, PyReader  # noqa: F401

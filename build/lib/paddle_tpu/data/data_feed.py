@@ -8,7 +8,7 @@ TPU-native re-design: slots are fixed-shape dense tensors (the padded-batch
 convention used framework-wide), one sample per recordio record as
 concatenated little-endian slot buffers. Parsing a batch is one
 ``np.frombuffer`` + reshape per slot — no per-value Python. The C++ side
-(``paddle_tpu/native_src/prefetch_queue.cc``) owns file reading and prefetch threading.
+(``native/prefetch_queue.cc``) owns file reading and prefetch threading.
 """
 
 import numpy as np

@@ -1,0 +1,94 @@
+"""py_reader: decoupled feed with background prefetch + device staging.
+
+Reference: ``layers/io.py:636`` py_reader + ``create_py_reader_op`` /
+``lod_tensor_blocking_queue.h`` / ``buffered_reader.cc`` (double-buffer
+prefetch to device). TPU-native version: a background thread converts
+batches via DataFeeder and issues ``jax.device_put`` ahead of consumption so
+H2D overlaps the previous step's compute.
+"""
+
+import queue
+import threading
+
+import jax
+import numpy as np
+
+__all__ = ["py_reader", "PyReader"]
+
+
+class PyReader:
+    def __init__(self, feed_list, capacity=16, device_put=True, program=None):
+        from .feeder import DataFeeder
+
+        self._feeder = DataFeeder(feed_list, program=program)
+        self._capacity = capacity
+        self._device_put = device_put
+        self._reader = None
+        self._thread = None
+        self._queue = None
+        self._end = object()
+
+    def decorate_paddle_reader(self, reader):
+        """reader: generator of minibatches (lists of rows)."""
+        self._reader = reader
+
+    decorate_sample_list_generator = decorate_paddle_reader
+
+    def decorate_batch_generator(self, reader):
+        """reader yields ready feed dicts of numpy arrays."""
+        self._reader = reader
+        self._feeder = None
+
+    def start(self):
+        self._queue = queue.Queue(maxsize=self._capacity)
+
+        def worker():
+            try:
+                for item in self._reader():
+                    feed = self._feeder.feed(item) if self._feeder else dict(item)
+                    if self._device_put:
+                        feed = {k: jax.device_put(np.asarray(v))
+                                for k, v in feed.items()}
+                    self._queue.put(feed)
+            finally:
+                self._queue.put(self._end)
+
+        self._thread = threading.Thread(target=worker, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        if self._queue is not None:
+            while True:
+                try:
+                    self._queue.get_nowait()
+                except queue.Empty:
+                    break
+        self._thread = None
+
+    def __iter__(self):
+        if self._thread is None:
+            self.start()
+        while True:
+            item = self._queue.get()
+            if item is self._end:
+                self._thread = None
+                return
+            yield item
+
+
+def py_reader(capacity, shapes, dtypes, lod_levels=None, name=None,
+              use_double_buffer=True):
+    """API-parity constructor (ref ``layers/io.py:636``): declares the data
+    vars and returns a PyReader bound to them."""
+    from ..layers import io as layers_io
+
+    lod_levels = lod_levels or [0] * len(shapes)
+    feed_vars = []
+    for i, (shape, dtype, lod) in enumerate(zip(shapes, dtypes, lod_levels)):
+        feed_vars.append(layers_io.data(
+            name="%s_slot_%d" % (name or "py_reader", i),
+            shape=list(shape)[1:], dtype=dtype, lod_level=lod,
+            append_batch_size=True))
+    rd = PyReader(feed_vars, capacity=capacity, device_put=use_double_buffer)
+    rd.feed_vars = feed_vars
+    return rd

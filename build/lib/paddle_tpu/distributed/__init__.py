@@ -1,0 +1,1 @@
+"""Distributed launcher package (ref ``python/paddle/distributed/``)."""

@@ -1,0 +1,110 @@
+"""Multi-process launcher (ref ``python/paddle/distributed/launch.py``):
+
+    python -m paddle_tpu.distributed.launch --nproc_per_node=2 \\
+        [--started_port 6170] [--log_dir logs] train.py [args...]
+
+Spawns one worker per process slot with the PADDLE_TRAINER_* env protocol
+(``PADDLE_TRAINER_ID``, ``PADDLE_TRAINER_ENDPOINTS``,
+``PADDLE_CURRENT_ENDPOINT``) that ``parallel/env.py:init_distributed``
+consumes to form the jax.distributed world. Multi-node: pass
+``--cluster_node_ips`` + ``--node_ip`` and run the launcher once per node,
+exactly like the reference.
+
+Failure semantics: first worker failure terminates the rest and the
+launcher exits with that worker's code (the reference's fate-sharing
+behavior, which elastic setups rely on for whole-job restart).
+"""
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch"]
+
+
+def _parse_args(argv):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--nproc_per_node", type=int, default=1)
+    ap.add_argument("--cluster_node_ips", type=str, default="127.0.0.1")
+    ap.add_argument("--node_ip", type=str, default="127.0.0.1")
+    ap.add_argument("--started_port", type=int, default=6170)
+    ap.add_argument("--log_dir", type=str, default=None)
+    ap.add_argument("training_script", type=str)
+    ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return ap.parse_args(argv)
+
+
+def launch(argv=None):
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    ips = args.cluster_node_ips.split(",")
+    if args.node_ip not in ips:
+        sys.exit("--node_ip %s not in --cluster_node_ips %s"
+                 % (args.node_ip, args.cluster_node_ips))
+    endpoints = [
+        "%s:%d" % (ip, args.started_port + i)
+        for ip in ips for i in range(args.nproc_per_node)
+    ]
+    node_rank = ips.index(args.node_ip)
+    local_ids = range(node_rank * args.nproc_per_node,
+                      (node_rank + 1) * args.nproc_per_node)
+
+    if args.log_dir:
+        os.makedirs(args.log_dir, exist_ok=True)
+
+    procs = []
+    logs = []
+    for tid in local_ids:
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(tid),
+            "PADDLE_TRAINERS_NUM": str(len(endpoints)),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
+        })
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        out = None
+        if args.log_dir:
+            out = open(os.path.join(args.log_dir,
+                                    "workerlog.%d" % tid), "w")
+            logs.append(out)
+        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
+                                      stderr=subprocess.STDOUT
+                                      if out else None))
+
+    rc = 0
+    try:
+        live = {p.pid: p for p in procs}
+        while live:
+            for pid, p in list(live.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                del live[pid]
+                if code != 0:
+                    # fate-sharing: one failure kills the job
+                    rc = code
+                    for q in live.values():
+                        q.send_signal(signal.SIGTERM)
+                    deadline = time.time() + 10
+                    for q in live.values():
+                        try:
+                            q.wait(max(0.1, deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    live = {}
+                    break
+            time.sleep(0.2)
+    finally:
+        for f in logs:
+            f.close()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

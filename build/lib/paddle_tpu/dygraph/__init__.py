@@ -1,0 +1,13 @@
+"""Imperative (dygraph) mode — ref ``python/paddle/fluid/imperative/``.
+
+Eager execution over jax arrays with a Layer/module system; ``to_variable``
+wraps arrays, autograd via jax transforms on ``Layer.__call__`` graphs.
+"""
+
+from . import base
+from .base import (guard, to_variable, enabled, no_grad,  # noqa: F401
+                   record, VarBase)
+from .layers import Layer  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import SGDOptimizer, AdamOptimizer  # noqa: F401

@@ -1,0 +1,227 @@
+"""Dygraph base (ref ``python/paddle/fluid/imperative/base.py``: ``guard:29``,
+``to_variable:47``; VarBase/tape semantics from ``imperative/layer.h:113`` +
+``engine.cc``).
+
+Eager mode runs jnp ops immediately; every recorded op also remembers its
+pure function + parent VarBases, so ``loss.backward()`` walks the graph in
+reverse calling ``jax.vjp`` per node — an eager tape with XLA-computed
+per-op VJPs. ``dygraph.grad``/``Layer.functional()`` remain the functional
+(whole-graph jit) path for dygraph→XLA training steps.
+"""
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_dygraph_tracer = None
+_grad_enabled = True
+
+# explicit randomness stream for jit-safe stochastic layers (Dropout):
+# under Layer.functional(..., rng=True) the apply function seeds this per
+# call, so every trace/step draws fresh, reproducible keys instead of a
+# trace-frozen module key
+_rng_stream = [None]
+
+
+def set_rng(key):
+    _rng_stream[0] = key
+
+
+def next_key():
+    """Next key from the explicit stream, or None when unseeded (legacy
+    eager behavior: layers fall back to their module-level key)."""
+    if _rng_stream[0] is None:
+        return None
+    _rng_stream[0], sub = jax.random.split(_rng_stream[0])
+    return sub
+
+
+def _in_dygraph_mode():
+    return _dygraph_tracer is not None
+
+
+def enabled():
+    return _in_dygraph_mode()
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    global _dygraph_tracer
+    prev = _dygraph_tracer
+    _dygraph_tracer = object()
+    try:
+        yield
+    finally:
+        _dygraph_tracer = prev
+
+
+@contextlib.contextmanager
+def no_grad():
+    """Suspend tape recording (ref imperative ``_no_grad_``)."""
+    global _grad_enabled
+    prev = _grad_enabled
+    _grad_enabled = False
+    try:
+        yield
+    finally:
+        _grad_enabled = prev
+
+
+class VarBase:
+    """Eager tensor (ref ``imperative/layer.h:113`` VarBase): holds a value
+    and, when produced by a recorded op, its tape node."""
+
+    def __init__(self, value, stop_gradient=False, name=None):
+        self._value = jnp.asarray(value)
+        self.stop_gradient = stop_gradient
+        self.name = name
+        self._grad = None
+        # (pure_fn, input list, forward-time values) when tape-recorded;
+        # values are SNAPSHOTTED so an in-place parameter update between
+        # forward and backward (optimizer.minimize on another loss) cannot
+        # silently change what the VJP is evaluated at
+        self._producer = None
+
+    @property
+    def shape(self):
+        return tuple(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def value(self):
+        return self._value
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad=None):
+        """Reverse-mode through the tape from this var (ref
+        VarBase::RunBackward / engine.cc: reverse traversal with gradient
+        accumulation; here each node's VJP comes from jax.vjp)."""
+        seed = jnp.ones_like(self._value) if grad is None \
+            else jnp.asarray(grad)
+        # iterative DFS (deep tapes — unrolled RNNs — overflow the Python
+        # recursion limit otherwise)
+        order = []
+        seen = set()
+        stack = [(self, False)]
+        while stack:
+            v, expanded = stack.pop()
+            if v._producer is None:
+                continue
+            if expanded:
+                order.append(v)
+                continue
+            if id(v) in seen:
+                continue
+            seen.add(id(v))
+            stack.append((v, True))
+            for p in v._producer[1]:
+                if isinstance(p, VarBase):
+                    stack.append((p, False))
+        grads = {id(self): seed}
+        for v in reversed(order):
+            g = grads.pop(id(v), None)
+            if g is None:
+                continue
+            fn, inputs, vals = v._producer
+            _, vjp_fn = jax.vjp(fn, *vals)
+            in_grads = vjp_fn(g.astype(v._value.dtype))
+            for p, ig in zip(inputs, in_grads):
+                if not isinstance(p, VarBase) or p.stop_gradient:
+                    continue
+                if p._producer is None:
+                    # leaf (parameter / input): accumulate into .gradient()
+                    p._grad = ig if p._grad is None else p._grad + ig
+                else:
+                    cur = grads.get(id(p))
+                    grads[id(p)] = ig if cur is None else cur + ig
+
+    def gradient(self):
+        return None if self._grad is None else np.asarray(self._grad)
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self):
+        return VarBase(self._value, stop_gradient=True, name=self.name)
+
+    # -- eager operator sugar (tape-recorded) -------------------------------
+    def _binop(self, other, fn):
+        other = other if isinstance(other, VarBase) else jnp.asarray(other)
+        return record(fn, self, other)
+
+    def __add__(self, o):
+        return self._binop(o, lambda a, b: a + b)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, lambda a, b: a - b)
+
+    def __rsub__(self, o):
+        return self._binop(o, lambda a, b: b - a)
+
+    def __mul__(self, o):
+        return self._binop(o, lambda a, b: a * b)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, lambda a, b: a / b)
+
+    def __rtruediv__(self, o):
+        return self._binop(o, lambda a, b: b / a)
+
+    def __pow__(self, o):
+        return self._binop(o, lambda a, b: a ** b)
+
+    def __matmul__(self, o):
+        return self._binop(o, lambda a, b: a @ b)
+
+    def __neg__(self):
+        return record(lambda a: -a, self)
+
+    def mean(self, axis=None):
+        return record(lambda a: jnp.mean(a, axis=axis), self)
+
+    def sum(self, axis=None):
+        return record(lambda a: jnp.sum(a, axis=axis), self)
+
+    def reshape(self, shape):
+        return record(lambda a: a.reshape(shape), self)
+
+    def transpose(self, perm):
+        return record(lambda a: a.transpose(perm), self)
+
+    def astype(self, dtype):
+        return record(lambda a: a.astype(dtype), self)
+
+    def __repr__(self):
+        return "VarBase(%s)" % (self._value,)
+
+
+def record(fn, *inputs):
+    """Run ``fn`` eagerly over the unwrapped inputs; attach a tape node
+    when any input is a grad-requiring VarBase. ``fn`` must be pure
+    (jnp-only) — its VJP is taken with jax.vjp at backward time."""
+    vals = [p._value if isinstance(p, VarBase) else p for p in inputs]
+    out = VarBase(fn(*vals))
+    if _grad_enabled and any(isinstance(p, VarBase) and not p.stop_gradient
+                             for p in inputs):
+        out._producer = (fn, list(inputs), vals)
+    return out
+
+
+def to_variable(value, block=None, name=None):
+    if isinstance(value, VarBase):
+        return value
+    if isinstance(value, jax.Array) or hasattr(value, "aval"):
+        # device arrays and tracers (functional/jit path) wrap directly
+        return VarBase(value, name=name)
+    return VarBase(np.asarray(value), name=name)

@@ -1,0 +1,176 @@
+"""Dygraph Layer/module system (ref ``python/paddle/fluid/imperative/layers.py:28``).
+
+Layers own named parameters (jnp arrays) and compose; ``functional()``
+exports a pure ``apply(params, *inputs)`` + the params pytree so training
+steps jit cleanly (dygraph→XLA, the reference's nascent imperative mode done
+the jax way)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import unique_name
+from ..core.initializer import XavierInitializer, ConstantInitializer
+from .base import VarBase, to_variable
+
+
+class _HostBlock:
+    """Minimal Block-protocol shim so core initializers can run eagerly."""
+
+    def __init__(self, rng):
+        self.ops = []
+        self.rng = rng
+
+    def append_op(self, type, inputs=None, outputs=None, attrs=None):
+        # execute the init op immediately on host
+        from ..core.framework import convert_np_dtype
+
+        attrs = attrs or {}
+        var = outputs["Out"] if not isinstance(outputs["Out"], list) else outputs["Out"][0]
+        shape = tuple(attrs.get("shape", var.shape))
+        dtype = convert_np_dtype(attrs.get("dtype", "float32"))
+        self.rng, sub = jax.random.split(self.rng)
+        if type == "fill_constant":
+            val = jnp.full(shape, attrs["value"], dtype=dtype)
+        elif type == "uniform_random":
+            val = jax.random.uniform(sub, shape, minval=attrs["min"],
+                                     maxval=attrs["max"]).astype(dtype)
+        elif type == "gaussian_random":
+            val = attrs["mean"] + attrs["std"] * jax.random.normal(sub, shape)
+            val = val.astype(dtype)
+        elif type == "truncated_gaussian_random":
+            val = attrs["mean"] + attrs["std"] * jax.random.truncated_normal(
+                sub, -2.0, 2.0, shape)
+            val = val.astype(dtype)
+        elif type == "assign_value":
+            val = jnp.asarray(
+                np.array(attrs["values"], dtype=dtype).reshape(shape))
+        else:
+            raise NotImplementedError("eager init op %s" % type)
+        var._eager_value = val
+
+
+class _InitVar:
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = np.dtype(dtype)
+        self._eager_value = None
+
+
+class Layer:
+    """Base module (ref ``imperative/layers.py`` Layer)."""
+
+    _rng = jax.random.PRNGKey(0)
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self._full_name = unique_name.generate(
+            name_scope or self.__class__.__name__.lower())
+        self._parameters = {}
+        self._sub_layers = {}
+        self._dtype = dtype
+        self.training = True
+
+    def full_name(self):
+        return self._full_name
+
+    # -- parameter management ----------------------------------------------
+    def create_parameter(self, shape, dtype=None, name=None,
+                         initializer=None, is_bias=False):
+        init = initializer or (ConstantInitializer(0.0) if is_bias
+                               else XavierInitializer())
+        var = _InitVar(shape, dtype or self._dtype)
+        blk = _HostBlock(Layer._rng)
+        init(var, blk)
+        Layer._rng = blk.rng
+        pname = name or unique_name.generate(self._full_name + ".w")
+        p = VarBase(var._eager_value, name=pname)
+        self._parameters[pname] = p
+        return p
+
+    def add_parameter(self, name, param):
+        self._parameters[name] = param
+        return param
+
+    def add_sublayer(self, name, layer):
+        self._sub_layers[name] = layer
+        return layer
+
+    def __setattr__(self, name, value):
+        if isinstance(value, Layer):
+            self.__dict__.setdefault("_sub_layers", {})[name] = value
+        params = self.__dict__.get("_parameters")
+        if isinstance(value, VarBase) and params is not None and \
+                value.name in params:
+            pass
+        object.__setattr__(self, name, value)
+
+    def parameters(self, include_sublayers=True):
+        out = list(self._parameters.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.parameters())
+        return out
+
+    def sublayers(self, include_sublayers=True):
+        out = list(self._sub_layers.values())
+        if include_sublayers:
+            for l in self._sub_layers.values():
+                out.extend(l.sublayers())
+        return out
+
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+
+    # -- call ---------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- functional export (dygraph -> XLA) ---------------------------------
+    def state_pytree(self):
+        """{param_name: array} over self + sublayers."""
+        return {p.name: p.value() for p in self.parameters()}
+
+    def load_pytree(self, tree):
+        for p in self.parameters():
+            if p.name in tree:
+                p._value = jnp.asarray(tree[p.name])
+
+    def functional(self, rng=False):
+        """Return (apply_fn, params) where apply_fn(params, *inputs) swaps the
+        pytree into the parameters and runs forward — jit/grad-safe.
+        With ``rng=True`` the signature is ``apply_fn(params, key, *inputs)``
+        and stochastic layers (Dropout) draw fresh keys from ``key`` each
+        call instead of a trace-frozen module key."""
+        from . import base
+
+        params0 = self.state_pytree()
+        plist = self.parameters()
+
+        def apply_fn(params, *inputs):
+            saved = [p._value for p in plist]
+            if rng:
+                key, inputs = inputs[0], inputs[1:]
+            try:
+                if rng:
+                    base.set_rng(key)
+                for p in plist:
+                    p._value = params[p.name]
+                out = self.forward(*[to_variable(i) for i in inputs])
+                return out.value() if isinstance(out, VarBase) else out
+            finally:
+                if rng:
+                    base.set_rng(None)
+                for p, s in zip(plist, saved):
+                    p._value = s
+
+        return apply_fn, params0

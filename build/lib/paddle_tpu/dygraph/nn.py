@@ -1,0 +1,493 @@
+"""Dygraph layers (ref ``python/paddle/fluid/imperative/nn.py``: Conv2D,
+Pool2D, FC, BatchNorm, Embedding + extras needed by BERT)."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core.initializer import (ConstantInitializer, NormalInitializer,
+                                XavierInitializer)
+from .base import VarBase, record, to_variable
+from .layers import Layer
+
+__all__ = ["FC", "Linear", "Conv2D", "Conv2DTranspose", "Pool2D",
+           "BatchNorm", "GroupNorm", "SpectralNorm", "Embedding",
+           "LayerNorm", "Dropout", "PRelu", "GRUUnit",
+           "BilinearTensorProduct", "NCE"]
+
+
+class FC(Layer):
+    def __init__(self, name_scope=None, size=None, input_dim=None,
+                 num_flatten_dims=1, act=None, param_attr=None,
+                 bias_attr=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._num_flatten_dims = num_flatten_dims
+        self._act = act
+        self._input_dim = input_dim
+        self._w = None
+        self._b = None
+
+    def _build_once(self, input_dim):
+        self._w = self.create_parameter([input_dim, self._size])
+        self._b = self.create_parameter([self._size], is_bias=True)
+
+    def forward(self, x):
+        import numpy as np
+
+        x = to_variable(x)
+        flat_in = int(np.prod(x.shape[self._num_flatten_dims:]))
+        if self._w is None:
+            self._build_once(flat_in)
+        act, size, nfd = self._act, self._size, self._num_flatten_dims
+
+        def fn(xv, w, b):
+            if xv.dtype == jnp.bfloat16:  # compute follows activation
+                w, b = w.astype(xv.dtype), b.astype(xv.dtype)
+            xv2 = xv.reshape(int(np.prod(xv.shape[:nfd])), -1)
+            out = (xv2 @ w + b).reshape(tuple(xv.shape[:nfd]) + (size,))
+            if act:
+                out = getattr(jax.nn, act)(out) if hasattr(jax.nn, act) \
+                    else getattr(jnp, act)(out)
+            return out
+
+        return record(fn, x, self._w, self._b)
+
+
+Linear = FC
+
+
+class Conv2D(Layer):
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=3, stride=1, padding=0, dilation=1, groups=1,
+                 act=None, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        k = filter_size if isinstance(filter_size, (list, tuple)) else (filter_size,) * 2
+        self._stride = stride if isinstance(stride, (list, tuple)) else (stride,) * 2
+        self._padding = padding if isinstance(padding, (list, tuple)) else (padding,) * 2
+        self._dilation = dilation if isinstance(dilation, (list, tuple)) else (dilation,) * 2
+        self._groups = groups
+        self._act = act
+        std = math.sqrt(2.0 / (k[0] * k[1] * num_channels))
+        self._filter = self.create_parameter(
+            [num_filters, num_channels // groups, k[0], k[1]],
+            initializer=NormalInitializer(0.0, std))
+        self._bias = self.create_parameter([num_filters], is_bias=True)
+
+    def forward(self, x):
+        stride, pad, dil = self._stride, self._padding, self._dilation
+        groups, act = self._groups, self._act
+
+        def fn(xv, w, b):
+            out = jax.lax.conv_general_dilated(
+                xv, w, window_strides=tuple(stride),
+                padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                rhs_dilation=tuple(dil), feature_group_count=groups,
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+            out = out + b.reshape(1, -1, 1, 1)
+            return jax.nn.relu(out) if act == "relu" else out
+
+        return record(fn, to_variable(x), self._filter, self._bias)
+
+
+class Pool2D(Layer):
+    def __init__(self, name_scope=None, pool_size=2, pool_type="max",
+                 pool_stride=2, pool_padding=0, global_pooling=False,
+                 dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        self._size = pool_size if isinstance(pool_size, (list, tuple)) else (pool_size,) * 2
+        self._stride = pool_stride if isinstance(pool_stride, (list, tuple)) else (pool_stride,) * 2
+        self._padding = pool_padding if isinstance(pool_padding, (list, tuple)) else (pool_padding,) * 2
+        self._type = pool_type
+        self._global = global_pooling
+
+    def forward(self, x):
+        size, stride_, pad = self._size, self._stride, self._padding
+        gpool, ptype = self._global, self._type
+
+        def fn(xv):
+            if gpool:
+                red = jnp.max if ptype == "max" else jnp.mean
+                return red(xv, axis=(2, 3), keepdims=True)
+            window = (1, 1) + tuple(size)
+            stride = (1, 1) + tuple(stride_)
+            pads = [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])]
+            if ptype == "max":
+                return jax.lax.reduce_window(xv, -jnp.inf, jax.lax.max,
+                                             window, stride, pads)
+            sm = jax.lax.reduce_window(xv, 0.0, jax.lax.add, window,
+                                       stride, pads)
+            return sm / (size[0] * size[1])
+
+        return record(fn, to_variable(x))
+
+
+class BatchNorm(Layer):
+    def __init__(self, name_scope=None, num_channels=None, act=None,
+                 momentum=0.9, epsilon=1e-5, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        c = num_channels
+        self._scale = self.create_parameter(
+            [c], initializer=ConstantInitializer(1.0))
+        self._bias = self.create_parameter([c], is_bias=True)
+        self._mean = VarBase(jnp.zeros((c,)), stop_gradient=True,
+                             name=self._full_name + ".mean")
+        self._var = VarBase(jnp.ones((c,)), stop_gradient=True,
+                            name=self._full_name + ".var")
+        self._momentum = momentum
+        self._eps = epsilon
+        self._act = act
+
+    def forward(self, x):
+        x = to_variable(x)
+        xv = x.value()
+        cshape = (1, -1) + (1,) * (xv.ndim - 2)
+        eps, act = self._eps, self._act
+        if self.training:
+            # the eager stats here feed ONLY the running-average update;
+            # the taped fn below recomputes them so its VJP stays correct
+            # (pure-fn tape nodes recompute by design)
+            axes = tuple(i for i in range(xv.ndim) if i != 1)
+            mu = jnp.mean(xv, axis=axes)
+            var = jnp.var(xv, axis=axes)
+            self._mean._value = (self._momentum * self._mean.value()
+                                 + (1 - self._momentum) * jax.lax.stop_gradient(mu))
+            self._var._value = (self._momentum * self._var.value()
+                                + (1 - self._momentum) * jax.lax.stop_gradient(var))
+
+            def fn(xv_, scale, bias):
+                m = jnp.mean(xv_, axis=axes)
+                v = jnp.var(xv_, axis=axes)
+                out = (xv_ - m.reshape(cshape)) * jax.lax.rsqrt(
+                    v.reshape(cshape) + eps)
+                out = out * scale.reshape(cshape) + bias.reshape(cshape)
+                return jax.nn.relu(out) if act == "relu" else out
+
+            return record(fn, x, self._scale, self._bias)
+
+        def fn(xv_, scale, bias, mu, var):
+            out = (xv_ - mu.reshape(cshape)) * jax.lax.rsqrt(
+                var.reshape(cshape) + eps)
+            out = out * scale.reshape(cshape) + bias.reshape(cshape)
+            return jax.nn.relu(out) if act == "relu" else out
+
+        return record(fn, x, self._scale, self._bias,
+                      self._mean.value(), self._var.value())
+
+
+class LayerNorm(Layer):
+    def __init__(self, name_scope=None, normalized_shape=None, epsilon=1e-5,
+                 dtype="float32"):
+        super().__init__(name_scope, dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = [normalized_shape]
+        self._shape = list(normalized_shape)
+        self._scale = self.create_parameter(
+            self._shape, initializer=ConstantInitializer(1.0))
+        self._bias = self.create_parameter(self._shape, is_bias=True)
+        self._eps = epsilon
+
+    def forward(self, x):
+        x = to_variable(x)
+        nshape, eps = len(self._shape), self._eps
+
+        def fn(xv, scale, bias):
+            in_dtype = xv.dtype
+            if in_dtype == jnp.bfloat16:  # f32 stats, bf16-resident out
+                xv = xv.astype(jnp.float32)
+            axes = tuple(range(xv.ndim - nshape, xv.ndim))
+            mu = jnp.mean(xv, axis=axes, keepdims=True)
+            var = jnp.var(xv, axis=axes, keepdims=True)
+            out = (xv - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+            return out.astype(in_dtype)
+
+        return record(fn, x, self._scale, self._bias)
+
+
+class Embedding(Layer):
+    def __init__(self, name_scope=None, size=None, is_sparse=False,
+                 padding_idx=None, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        self._size = size
+        self._padding_idx = padding_idx
+        self._w = self.create_parameter(
+            list(size), initializer=XavierInitializer())
+
+    def forward(self, ids):
+        pad_idx = self._padding_idx
+
+        def fn(iv, w):
+            iv = iv.astype(jnp.int32)
+            if iv.ndim >= 2 and iv.shape[-1] == 1:
+                iv = iv.squeeze(-1)
+            out = jnp.take(w, iv, axis=0)
+            if pad_idx is not None:
+                out = out * (iv != pad_idx)[..., None].astype(out.dtype)
+            return out
+
+        # integer ids carry no gradient; mark a LOCAL copy, never the
+        # caller's VarBase
+        ids = VarBase(to_variable(ids).value(), stop_gradient=True)
+        return record(fn, ids, self._w)
+
+
+class Dropout(Layer):
+    _key = jax.random.PRNGKey(1234)
+
+    def __init__(self, name_scope=None, p=0.5):
+        super().__init__(name_scope)
+        self._p = p
+
+    def forward(self, x):
+        from . import base
+
+        x = to_variable(x)
+        if not self.training or self._p == 0.0:
+            return x
+        sub = base.next_key()
+        if sub is None:  # legacy eager stream
+            Dropout._key, sub = jax.random.split(Dropout._key)
+        p = self._p
+
+        def fn(xv):
+            keep = jax.random.bernoulli(sub, 1.0 - p, xv.shape)
+            return xv * keep / (1.0 - p)
+
+        return record(fn, x)
+
+
+class PRelu(Layer):
+    def __init__(self, name_scope=None, mode="all", dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._alpha = self.create_parameter(
+            [1], initializer=ConstantInitializer(0.25))
+
+    def forward(self, x):
+        return record(lambda xv, a: jnp.where(xv > 0, xv, a * xv),
+                      to_variable(x), self._alpha)
+
+
+class Conv2DTranspose(Layer):
+    """Ref ``imperative/nn.py``-era Conv2DTranspose wrapping
+    ``conv2d_transpose_op`` (IOHW kernel layout)."""
+
+    def __init__(self, name_scope=None, num_channels=None, num_filters=None,
+                 filter_size=3, stride=1, padding=0, act=None,
+                 dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+
+        def pair(v):
+            return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+        self._stride, self._pad = pair(stride), pair(padding)
+        self._act = act
+        fs = pair(filter_size)
+        self._w = self.create_parameter(
+            [num_channels, num_filters, fs[0], fs[1]])
+        self._b = self.create_parameter([num_filters], is_bias=True)
+
+    def forward(self, x):
+        from ..core.opimpl.nn_ops import conv_transpose_nchw
+
+        s, p, act = self._stride, self._pad, self._act
+
+        def fn(xv, w, b):
+            out = conv_transpose_nchw(xv, w, s, p, (1, 1))
+            out = out + b.reshape(1, -1, 1, 1)
+            if act:
+                out = getattr(jax.nn, act)(out)
+            return out
+
+        return record(fn, to_variable(x), self._w, self._b)
+
+
+class GroupNorm(Layer):
+    """Ref ``group_norm_op`` as a module (NCHW)."""
+
+    def __init__(self, name_scope=None, channels=None, groups=1,
+                 epsilon=1e-5, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._groups = groups
+        self._eps = epsilon
+        self._scale = self.create_parameter(
+            [channels], initializer=ConstantInitializer(1.0))
+        self._bias = self.create_parameter([channels], is_bias=True)
+
+    def forward(self, x):
+        g, eps = self._groups, self._eps
+
+        def fn(xv, scale, bias):
+            n, c = xv.shape[0], xv.shape[1]
+            xg = xv.reshape((n, g, c // g) + xv.shape[2:])
+            axes = tuple(range(2, xg.ndim))
+            mu = jnp.mean(xg, axis=axes, keepdims=True)
+            var = jnp.var(xg, axis=axes, keepdims=True)
+            y = ((xg - mu) * jax.lax.rsqrt(var + eps)).reshape(xv.shape)
+            cshape = (1, c) + (1,) * (xv.ndim - 2)
+            return y * scale.reshape(cshape) + bias.reshape(cshape)
+
+        return record(fn, to_variable(x), self._scale, self._bias)
+
+
+class SpectralNorm(Layer):
+    """Ref ``spectral_norm_op``: weight / sigma_max via power iteration
+    (u, v buffers advance eagerly per call, matching the op's in-place
+    U/V update)."""
+
+    def __init__(self, name_scope=None, weight_shape=None, dim=0,
+                 power_iters=1, eps=1e-12, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._dim = dim
+        self._iters = power_iters
+        self._eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        # U/V are NO-GRAD buffers (ref spectral_norm_op: persistable
+        # state advanced by the kernel, never optimizer-updated) — plain
+        # arrays, not registered parameters
+        key = jax.random.PRNGKey(17)
+        ku, kv = jax.random.split(key)
+        self._u = jax.random.normal(ku, (h,), jnp.float32)
+        self._v = jax.random.normal(kv, (w,), jnp.float32)
+
+    def forward(self, weight):
+        weight = to_variable(weight)
+        dim, iters, eps = self._dim, self._iters, self._eps
+
+        # power iteration with the CURRENT buffers; sigma's u, v are
+        # constants w.r.t. the gradient (the reference grad kernel treats
+        # them as fixed vectors), so they enter fn by closure, not as
+        # differentiable inputs
+        wv = weight.value()
+        wm0 = jax.lax.stop_gradient(
+            jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1))
+        u, v = self._u, self._v
+        for _ in range(iters):
+            v = wm0.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm0 @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        u = jax.lax.stop_gradient(u)
+        v = jax.lax.stop_gradient(v)
+        if not isinstance(wv, jax.core.Tracer):
+            # eager: advance the buffers; under jit the advance is part of
+            # the trace only (buffers hold concrete values across steps)
+            self._u, self._v = u, v
+
+        def fn(w_in):
+            wm = jnp.moveaxis(w_in, dim, 0).reshape(w_in.shape[dim], -1)
+            sigma = u @ wm @ v
+            return w_in / sigma
+
+        return record(fn, weight)
+
+
+class BilinearTensorProduct(Layer):
+    """Ref ``bilinear_tensor_product_op``: out_k = x^T W_k y + b_k."""
+
+    def __init__(self, name_scope=None, input1_dim=None, input2_dim=None,
+                 output_dim=None, act=None, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._act = act
+        self._w = self.create_parameter(
+            [output_dim, input1_dim, input2_dim])
+        self._b = self.create_parameter([output_dim], is_bias=True)
+
+    def forward(self, x, y):
+        act = self._act
+
+        def fn(xv, yv, w, b):
+            out = jnp.einsum("bi,kij,bj->bk", xv, w, yv) + b
+            if act:
+                out = getattr(jax.nn, act)(out)
+            return out
+
+        return record(fn, to_variable(x), to_variable(y), self._w, self._b)
+
+
+class NCE(Layer):
+    """Ref ``imperative`` NCE wrapping ``nce_op``: noise-contrastive loss
+    with uniform negative sampling."""
+
+    def __init__(self, name_scope=None, num_total_classes=None, dim=None,
+                 num_neg_samples=10, dtype="float32"):
+        super().__init__(name_scope, dtype)
+        self._n_classes = num_total_classes
+        self._n_neg = num_neg_samples
+        self._w = self.create_parameter([num_total_classes, dim])
+        self._b = self.create_parameter([num_total_classes], is_bias=True)
+
+    _key = jax.random.PRNGKey(4321)
+
+    def forward(self, x, label):
+        from . import base
+
+        n_cls, n_neg = self._n_classes, self._n_neg
+        sub = base.next_key()
+        if sub is None:  # own eager stream, independent of Dropout's
+            NCE._key, sub = jax.random.split(NCE._key)
+        label = VarBase(to_variable(label).value(), stop_gradient=True)
+
+        def fn(xv, lv, w, b):
+            lv = lv.reshape(-1).astype(jnp.int32)
+            bsz = xv.shape[0]
+            neg = jax.random.randint(sub, (bsz, n_neg), 0, n_cls)
+            pos_logit = jnp.sum(xv * w[lv], axis=-1) + b[lv]
+            neg_logit = jnp.einsum("bd,bnd->bn", xv, w[neg]) + b[neg]
+            # uniform noise distribution q = 1/n_classes
+            log_q = -jnp.log(float(n_cls))
+            pos_loss = -jax.nn.log_sigmoid(pos_logit - log_q)
+            neg_loss = -jnp.sum(
+                jax.nn.log_sigmoid(-(neg_logit - log_q)), axis=-1)
+            return (pos_loss + neg_loss).reshape(-1, 1)
+
+        return record(fn, to_variable(x), label, self._w, self._b)
+
+
+class GRUUnit(Layer):
+    """Single-step GRU cell (ref ``imperative/nn.py`` GRUUnit wrapping
+    ``gru_unit_op``): gates from [x_t | h_{t-1}]."""
+
+    def __init__(self, name_scope=None, size=None, dtype="float32", **kw):
+        super().__init__(name_scope, dtype)
+        # size is 3*hidden (the reference convention)
+        self._hidden = size // 3
+        self._gate_w = None
+        self._cand_w = None
+
+    def _build_once(self, input_dim):
+        h = self._hidden
+        self._gate_w = self.create_parameter([input_dim + h, 2 * h])
+        self._gate_b = self.create_parameter([2 * h], is_bias=True)
+        self._cand_w = self.create_parameter([input_dim + h, h])
+        self._cand_b = self.create_parameter([h], is_bias=True)
+
+    def forward(self, x, hidden):
+        x = to_variable(x)
+        hidden = to_variable(hidden)
+        if self._gate_w is None:
+            self._build_once(x.shape[-1])
+        h = self._hidden
+
+        # the gate projection is computed ONCE; hidden/reset_pre are taped
+        # children of the shared gate node (reference GRUUnit's 3-output
+        # contract: updated_hidden, reset_hidden_pre, gate)
+        gate = record(
+            lambda xv, hv, gw, gb: jax.nn.sigmoid(
+                jnp.concatenate([xv, hv], axis=-1) @ gw + gb),
+            x, hidden, self._gate_w, self._gate_b)
+
+        def fn_hidden(g, xv, hv, cw, cb):
+            u, r = g[..., :h], g[..., h:]
+            cat_r = jnp.concatenate([xv, r * hv], axis=-1)
+            c = jnp.tanh(cat_r @ cw + cb)
+            return u * hv + (1.0 - u) * c
+
+        out = record(fn_hidden, gate, x, hidden, self._cand_w,
+                     self._cand_b)
+        reset_pre = record(lambda g, hv: g[..., h:] * hv, gate, hidden)
+        return out, reset_pre, gate

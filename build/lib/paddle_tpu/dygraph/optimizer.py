@@ -1,0 +1,60 @@
+"""Dygraph optimizers (ref ``imperative`` mode's use of
+``fluid.optimizer.*Optimizer(...).minimize(loss)`` over tape gradients).
+
+Tape-native: ``minimize(loss)`` runs ``loss.backward()`` (unless grads are
+already populated), applies the update to each parameter's value in place,
+and clears gradients."""
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SGDOptimizer", "AdamOptimizer"]
+
+
+class _DygraphOptimizer:
+    def __init__(self, learning_rate, parameter_list):
+        self._lr = learning_rate
+        self._params = list(parameter_list)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None):
+        if all(p._grad is None for p in self._params):
+            loss.backward()
+        for p in self._params:
+            if p._grad is None:
+                continue
+            self._apply(p)
+        self.clear_gradients()
+
+    def clear_gradients(self):
+        for p in self._params:
+            p.clear_gradient()
+
+
+class SGDOptimizer(_DygraphOptimizer):
+    def _apply(self, p):
+        p._value = p._value - self._lr * p._grad
+
+
+class AdamOptimizer(_DygraphOptimizer):
+    def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameter_list=()):
+        super().__init__(learning_rate, parameter_list)
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._m = {}
+        self._v = {}
+        self._t = 0
+
+    def minimize(self, loss, startup_program=None, parameter_list=None):
+        self._t += 1
+        super().minimize(loss, startup_program, parameter_list)
+
+    def _apply(self, p):
+        k = id(p)
+        m = self._m.get(k, jnp.zeros_like(p._value))
+        v = self._v.get(k, jnp.zeros_like(p._value))
+        g = p._grad
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * g * g
+        self._m[k], self._v[k] = m, v
+        corr = np.sqrt(1 - self._b2 ** self._t) / (1 - self._b1 ** self._t)
+        p._value = p._value - self._lr * corr * m / (jnp.sqrt(v) + self._eps)

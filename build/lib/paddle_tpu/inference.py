@@ -1,0 +1,141 @@
+"""Inference predictor stack.
+
+Reference: ``paddle/fluid/inference/api/analysis_predictor.cc:183``
+(AnalysisPredictor::Init) + ``:734`` (Run), configured by
+``paddle_inference_api.h`` AnalysisConfig, exposed in Python as
+``fluid.core.AnalysisConfig`` / ``create_paddle_predictor``.
+
+TPU-native re-design: the analysis pass stack (IR optimization, fusion,
+TensorRT/MKLDNN subgraphs, memory optimization) is subsumed by XLA
+compilation of the whole pruned program — the predictor's job is model
+loading, an isolated scope, a warm shape-keyed jit cache (the Executor's
+program cache), and zero-copy device-resident feeds (jax.Array passthrough).
+"""
+
+import os
+
+from . import io as io_mod
+from .core.executor import Executor, Scope, scope_guard, XLAPlace
+
+__all__ = ["AnalysisConfig", "Predictor", "create_paddle_predictor"]
+
+
+class AnalysisConfig:
+    """Parity shim for the reference AnalysisConfig. Device/IR knobs that
+    have no TPU meaning are recorded but inert (XLA owns optimization)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        self.model_dir = model_dir
+        self.prog_file = prog_file
+        self.params_file = params_file
+        self._use_gpu = False
+        self._mem_optim = True
+        self._ir_optim = True
+
+    # -- reference-API surface (no-op on TPU, XLA subsumes) -----------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._use_gpu = True  # accepted; execution targets the XLA device
+
+    def disable_gpu(self):
+        self._use_gpu = False
+
+    def switch_ir_optim(self, x=True):
+        self._ir_optim = bool(x)
+
+    def enable_memory_optim(self, x=True):
+        self._mem_optim = bool(x)
+
+    def set_model(self, model_dir):
+        self.model_dir = model_dir
+
+
+class Predictor:
+    """Loads a saved inference model into an isolated scope and serves
+    ``run``/``predict`` with a warm compile cache.
+
+    Ref ``analysis_predictor.cc``: Init loads + optimizes the program once;
+    Run executes with feed/fetch binding. Here the first call per feed-shape
+    compiles (XLA) and subsequent calls hit the Executor's program cache."""
+
+    def __init__(self, config):
+        if isinstance(config, str):
+            config = AnalysisConfig(model_dir=config)
+        self.config = config
+        self._scope = Scope()
+        self._exe = Executor(XLAPlace(0))
+        model_dir = config.model_dir
+        # combined-file form (ref SetModel(prog_file, params_file)): the
+        # directory comes from the file paths, which must agree
+        for fp in (config.prog_file, config.params_file):
+            if fp is None:
+                continue
+            d = os.path.dirname(os.path.abspath(fp))
+            if model_dir is None:
+                model_dir = d
+            elif os.path.abspath(model_dir) != d:
+                raise ValueError(
+                    "AnalysisConfig: %r is not inside model_dir %r"
+                    % (fp, model_dir))
+        if model_dir is None:
+            raise ValueError("AnalysisConfig needs model_dir (the "
+                             "save_inference_model output directory) or "
+                             "prog_file/params_file paths")
+        with scope_guard(self._scope):
+            prog, feed_names, fetch_vars = io_mod.load_inference_model(
+                model_dir, self._exe,
+                model_filename=(os.path.basename(config.prog_file)
+                                if config.prog_file else None),
+                params_filename=(os.path.basename(config.params_file)
+                                 if config.params_file else None))
+        self._program = prog
+        self.feed_names = list(feed_names)
+        self._fetch_vars = fetch_vars
+        self.fetch_names = [v.name for v in fetch_vars]
+
+    def run(self, inputs, return_numpy=True):
+        """``inputs``: dict name->array, or a list/tuple in feed order.
+        Returns outputs in fetch order."""
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != len(self.feed_names):
+                raise ValueError("expected %d inputs (%s), got %d"
+                                 % (len(self.feed_names), self.feed_names,
+                                    len(inputs)))
+            feed = dict(zip(self.feed_names, inputs))
+        else:
+            feed = dict(inputs)
+            missing = set(self.feed_names) - set(feed)
+            if missing:
+                raise ValueError("missing feeds: %s" % sorted(missing))
+        # scope passed explicitly (not via the global scope_guard stack):
+        # clones serving concurrently from other threads must not race on
+        # process-global scope resolution
+        return self._exe.run(self._program, feed=feed,
+                             fetch_list=self._fetch_vars,
+                             scope=self._scope,
+                             return_numpy=return_numpy)
+
+    predict = run
+
+    def clone(self):
+        """A predictor sharing this one's weights (ref
+        ``AnalysisPredictor::Clone``): same scope/program, fresh exe cache."""
+        other = object.__new__(Predictor)
+        other.config = self.config
+        other._scope = self._scope
+        other._exe = Executor(XLAPlace(0))
+        other._program = self._program
+        other.feed_names = list(self.feed_names)
+        other._fetch_vars = self._fetch_vars
+        other.fetch_names = list(self.fetch_names)
+        return other
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return list(self.fetch_names)
+
+
+def create_paddle_predictor(config):
+    """Factory-name parity with the reference C-API."""
+    return Predictor(config)

@@ -1,0 +1,184 @@
+"""Model persistence (ref ``python/paddle/fluid/io.py``: ``save_vars:92``,
+``save_params:213``, ``save_persistables:441``, ``load_persistables:658``,
+``save_inference_model:863``, ``load_inference_model:1015``).
+
+Format: one ``.npz`` bundle per save (atomic tmp+rename) + a JSON manifest —
+the capability of the reference's save/load-combine ops. Inference export
+serializes the pruned symbolic program with pickle alongside the params and
+also exports StableHLO text when shapes are concrete (the XLA-native
+"program binary").
+"""
+
+import json
+import os
+import pickle
+import tempfile
+
+import numpy as np
+
+from .core import framework
+from .core.executor import global_scope
+from .core.framework import Parameter
+
+__all__ = [
+    "save_vars", "save_params", "save_persistables", "load_vars",
+    "load_params", "load_persistables", "save_inference_model",
+    "load_inference_model", "save_checkpoint", "load_checkpoint",
+]
+
+
+def save_checkpoint(*args, **kwargs):
+    """Ref ``fluid.io`` checkpoint family; see ``paddle_tpu.checkpoint``."""
+    from .checkpoint import save_checkpoint as impl
+
+    return impl(*args, **kwargs)
+
+
+def load_checkpoint(*args, **kwargs):
+    from .checkpoint import load_checkpoint as impl
+
+    return impl(*args, **kwargs)
+
+
+def _collect(program, predicate):
+    return [v for v in program.list_vars() if predicate(v)]
+
+
+def _atomic_savez(path, arrays):
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    os.close(fd)
+    try:
+        np.savez(tmp, **arrays)
+        os.replace(tmp + ".npz" if os.path.exists(tmp + ".npz") else tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = _collect(main_program, predicate or (lambda v: v.persistable))
+    scope = global_scope()
+    arrays = {}
+    for v in vars:
+        if v.name in scope:
+            arrays[v.name] = np.asarray(scope.get(v.name))
+    path = os.path.join(dirname, filename or "__model_params__.npz")
+    _atomic_savez(path, arrays)
+    meta = {name: {"shape": list(a.shape), "dtype": str(a.dtype)}
+            for name, a in arrays.items()}
+    with open(os.path.join(dirname, "manifest.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    return path
+
+
+def save_params(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: isinstance(v, Parameter),
+                     filename=filename)
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    return save_vars(executor, dirname, main_program,
+                     predicate=lambda v: v.persistable, filename=filename)
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    main_program = main_program or framework.default_main_program()
+    if vars is None:
+        vars = _collect(main_program, predicate or (lambda v: v.persistable))
+    path = os.path.join(dirname, filename or "__model_params__.npz")
+    data = np.load(path, allow_pickle=False)
+    scope = global_scope()
+    import jax.numpy as jnp
+    for v in vars:
+        if v.name in data:
+            scope.set(v.name, jnp.asarray(data[v.name]))
+
+
+def load_params(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: isinstance(v, Parameter), filename=filename)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    load_vars(executor, dirname, main_program,
+              predicate=lambda v: v.persistable, filename=filename)
+
+
+def save_inference_model(dirname, feeded_var_names, target_vars, executor,
+                         main_program=None, model_filename=None,
+                         params_filename=None, export_for_deployment=True):
+    """Prune to the fetch targets, save program + params (ref ``io.py:863``).
+    Also exports StableHLO when the feed shapes are fully static."""
+    main_program = main_program or framework.default_main_program()
+    inference_program = main_program.clone(for_test=True)
+    targets = [inference_program.global_block().var(v.name)
+               for v in target_vars]
+    pruned = inference_program.prune(targets)
+    os.makedirs(dirname, exist_ok=True)
+    save_persistables(executor, dirname, pruned,
+                      filename=params_filename or "params.npz")
+    model = {
+        "feed_names": list(feeded_var_names),
+        "fetch_names": [v.name for v in target_vars],
+        "program": pruned,
+    }
+    with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
+        pickle.dump(model, f)
+
+    # StableHLO export (the XLA-native serialized program)
+    try:
+        _export_stablehlo(dirname, pruned, feeded_var_names,
+                          [v.name for v in target_vars])
+    except Exception:
+        pass
+    return [v.name for v in target_vars]
+
+
+def _export_stablehlo(dirname, program, feed_names, fetch_names):
+    import jax
+    import jax.numpy as jnp
+    from .core.op_registry import run_op, RNG_KEY, RNG0_KEY
+
+    gb = program.global_block()
+    shapes = {}
+    for n in feed_names:
+        v = gb.var(n)
+        if v.shape is None or any(s < 0 for s in v.shape[1:]):
+            return
+        shapes[n] = (tuple(1 if s == -1 else s for s in v.shape), v.dtype)
+    scope = global_scope()
+    state = {v.name: scope.get(v.name) for v in program.list_vars()
+             if v.persistable and v.name in scope}
+
+    def fn(state, feed):
+        env = dict(state)
+        env.update(feed)
+        env[RNG_KEY] = jax.random.PRNGKey(0)
+        env[RNG0_KEY] = env[RNG_KEY]
+        for op in gb.ops:
+            run_op(env, op)
+        return tuple(env[n] for n in fetch_names)
+
+    feed_spec = {n: jax.ShapeDtypeStruct(s, d) for n, (s, d) in shapes.items()}
+    lowered = jax.jit(fn).lower(state, feed_spec)
+    with open(os.path.join(dirname, "model.stablehlo.mlir"), "w") as f:
+        f.write(lowered.as_text())
+
+
+def load_inference_model(dirname, executor, model_filename=None,
+                         params_filename=None):
+    with open(os.path.join(dirname, model_filename or "__model__"), "rb") as f:
+        model = pickle.load(f)
+    program = model["program"]
+    load_persistables(executor, dirname, program,
+                      filename=params_filename or "params.npz")
+    gb = program.global_block()
+    fetch_vars = [gb.var(n) for n in model["fetch_names"]]
+    return program, model["feed_names"], fetch_vars

@@ -1,0 +1,30 @@
+"""User-facing layers namespace (ref ``python/paddle/fluid/layers/``)."""
+
+from . import nn
+from . import ops
+from . import tensor
+from . import detection
+from . import extras
+from . import io
+from . import control_flow
+from . import metric_op
+from . import sequence_lod
+from . import learning_rate_scheduler
+from . import math_op_patch  # noqa: F401
+
+from .nn import *  # noqa: F401,F403
+from .ops import *  # noqa: F401,F403
+from .tensor import *  # noqa: F401,F403
+from .io import *  # noqa: F401,F403
+from .metric_op import *  # noqa: F401,F403
+from .sequence_lod import *  # noqa: F401,F403
+from .detection import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
+from .control_flow import (StaticRNN, DynamicRNN, While, Switch, cond,  # noqa: F401
+                           array_write, array_read, create_array,
+                           array_length, IfElse, less_than, equal,
+                           increment)
+from .learning_rate_scheduler import (  # noqa: F401
+    exponential_decay, natural_exp_decay, inverse_time_decay,
+    polynomial_decay, piecewise_decay, cosine_decay, noam_decay,
+    linear_lr_warmup)

@@ -1,0 +1,459 @@
+"""Control-flow layers (ref ``python/paddle/fluid/layers/control_flow.py``:
+While:504, StaticRNN:278, ConditionalBlock:1055, Switch:1138).
+
+TPU-native lowering: sub-block bodies are recorded symbolically and executed
+through ``lax.scan`` / ``lax.while_loop`` / ``lax.cond`` — compiler-friendly
+control flow with static shapes, replacing the reference's interpreter
+recursion into sub-BlockDescs.
+"""
+
+from ..core import framework
+from ..core.framework import Variable
+from ..core.layer_helper import LayerHelper
+
+__all__ = ["StaticRNN", "DynamicRNN", "While", "Switch", "cond", "increment",
+           "less_than", "equal", "array_write", "array_read",
+           "create_array", "array_length", "IfElse"]
+
+
+def less_than(x, y, force_cpu=None, cond=None):
+    """``cond`` (if given) receives the result in place — required inside a
+    While body so the loop condition var is actually updated (ref
+    ``layers/control_flow.py`` less_than cond semantics)."""
+    from .math_op_patch import binary
+    return binary(x, y, "less_than", out=cond)
+
+
+def equal(x, y, cond=None):
+    from .math_op_patch import binary
+    return binary(x, y, "equal", out=cond)
+
+
+def increment(x, value=1.0, in_place=True):
+    from . import tensor
+    return tensor.increment(x, value, in_place)
+
+
+class BlockGuard:
+    def __init__(self, program):
+        self.program = program
+
+    def __enter__(self):
+        self.block = self.program._create_block()
+        return self.block
+
+    def __exit__(self, *a):
+        self.program._rollback()
+        return False
+
+
+class StaticRNN:
+    """Static-length RNN (ref ``control_flow.py:278``): the step block is
+    recorded into a sub-block and lowered to one ``lax.scan`` — each step is
+    the fused step computation on the MXU.
+
+    Usage parity with the reference:
+        rnn = StaticRNN()
+        with rnn.step():
+            x_t = rnn.step_input(x)          # x: [B, T, D] (batch-major)
+            h = rnn.memory(shape=[H], batch_ref=x)
+            nh = some_layers(x_t, h)
+            rnn.update_memory(h, nh)
+            rnn.step_output(nh)
+        out = rnn()                           # [B, T, H]
+    """
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("static_rnn", name=name)
+        self._mems = []          # (pre_var, init_var)
+        self._mem_updates = {}   # pre_var.name -> post var
+        self._step_inputs = []   # (step_var, full_var)
+        self._step_outputs = []
+        self._block = None
+        self._entered = False
+
+    def step(self):
+        outer = self
+
+        class _Guard(BlockGuard):
+            def __init__(self):
+                super().__init__(framework.default_main_program())
+
+            def __enter__(self):
+                outer._block = super().__enter__()
+                outer._entered = True
+                return outer._block
+
+            def __exit__(self, *a):
+                outer._entered = False
+                return super().__exit__(*a)
+
+        return _Guard()
+
+    def step_input(self, x):
+        assert self._entered
+        step_var = self._block.create_var(
+            shape=(x.shape[0],) + tuple(x.shape[2:]), dtype=str(x.dtype))
+        self._step_inputs.append((step_var, x))
+        return step_var
+
+    def memory(self, init=None, shape=None, batch_ref=None,
+               init_value=0.0, init_batch_dim_idx=0, ref_batch_dim_idx=0):
+        assert self._entered
+        if init is None:
+            from . import tensor
+            assert batch_ref is not None
+            # build init OUTSIDE the step block
+            cur = framework.default_main_program().current_block()
+            saved_idx = framework.default_main_program().current_block_idx
+            framework.default_main_program().current_block_idx = 0
+            init = tensor.fill_constant_batch_size_like(
+                batch_ref, [1] + list(shape), str(batch_ref.dtype), init_value)
+            framework.default_main_program().current_block_idx = saved_idx
+        pre = self._block.create_var(shape=init.shape, dtype=str(init.dtype))
+        self._mems.append((pre, init))
+        return pre
+
+    def update_memory(self, mem, var):
+        self._mem_updates[mem.name] = var
+
+    def step_output(self, o):
+        self._step_outputs.append(o)
+
+    def output(self, *outputs):
+        for o in outputs:
+            self.step_output(o)
+
+    def __call__(self, *args, **kwargs):
+        prog = framework.default_main_program()
+        gb = prog.global_block()
+        step_ops = list(self._block.ops)
+        x_vars = [full for _, full in self._step_inputs]
+        # scan is time-major; wrap with transposes
+        from . import tensor as T
+        xs_tm = [T.transpose(x, [1, 0] + list(range(2, len(x.shape))))
+                 for x in x_vars]
+        init_vars = [init for _, init in self._mems]
+        carry_names = [pre.name for pre, _ in self._mems]
+        carry_out_names = [self._mem_updates[n].name for n in carry_names]
+        x_names = [sv.name for sv, _ in self._step_inputs]
+        y_names = [o.name for o in self._step_outputs]
+
+        lasts = [gb.create_var(shape=i.shape, dtype=str(i.dtype))
+                 for i in init_vars]
+        ys = [gb.create_var(shape=(x_vars[0].shape[1],) + tuple(o.shape),
+                            dtype=str(o.dtype)) for o in self._step_outputs]
+        gb.append_op(
+            "scan_block",
+            {"X": xs_tm, "Init": init_vars},
+            {"Last": lasts, "Ys": ys},
+            {"step_ops": step_ops, "x_step_names": x_names,
+             "carry_names": carry_names, "carry_out_names": carry_out_names,
+             "y_names": y_names})
+        outs = [T.transpose(y, [1, 0] + list(range(2, len(y.shape))))
+                for y in ys]
+        return outs[0] if len(outs) == 1 else outs
+
+
+class While:
+    """While loop (ref ``control_flow.py:504``) lowered to lax.while_loop.
+    Loop-carried vars must be listed via ``loop_vars``."""
+
+    def __init__(self, cond, loop_vars=None, name=None):
+        self.cond_var = cond
+        self.loop_vars = loop_vars or []
+        self.helper = LayerHelper("while", name=name)
+        self._guard = None
+
+    def block(self):
+        outer = self
+        prog = framework.default_main_program()
+
+        class _Guard(BlockGuard):
+            def __init__(self):
+                super().__init__(prog)
+
+            def __enter__(self):
+                outer._block = super().__enter__()
+                return outer._block
+
+            def __exit__(self, *exc):
+                r = super().__exit__(*exc)
+                if exc and exc[0] is not None:
+                    return r
+                gb = prog.global_block()
+                body_ops = list(outer._block.ops)
+                outs = [gb.create_var(shape=v.shape, dtype=str(v.dtype))
+                        for v in outer.loop_vars]
+                gb.append_op(
+                    "while_block",
+                    {"Carry": list(outer.loop_vars)},
+                    {"Out": outs},
+                    {"body_ops": body_ops,
+                     "cond_name": outer.cond_var.name})
+                for v, o in zip(outer.loop_vars, outs):
+                    # rebind names so later layers see updated values
+                    o.name = v.name
+                    gb.vars[v.name] = o
+                return r
+
+        return _Guard()
+
+
+class Switch:
+    """Piecewise-case construct (ref ``control_flow.py:1138``), commonly used
+    for LR schedules. First-match semantics: each case is guarded by
+    ``its_cond AND NOT(any prior cond)``; the default by ``NOT(any cond)``.
+    Lowered to jnp.where blending in run_op (see op_registry)."""
+
+    def __init__(self, name=None):
+        self.helper = LayerHelper("switch", name=name)
+        self._prior_conds = []
+
+    def case(self, condition):
+        return _SwitchCase(self, condition)
+
+    def default(self):
+        return _SwitchCase(self, None)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class _SwitchCase:
+    def __init__(self, switch, condition):
+        self.switch = switch
+        self.condition = condition
+        self.prog = framework.default_main_program()
+
+    def __enter__(self):
+        self.block = self.prog._create_block()
+        return self.block
+
+    def __exit__(self, *a):
+        self.prog._rollback()
+        ops = list(self.block.ops)
+        gb = self.prog.global_block()
+
+        # effective condition = this cond AND NOT(prior conds); default =
+        # NOT(any prior cond). Built with ops so it traces into the jit.
+        def _not(v):
+            o = gb.create_var(shape=v.shape or (1,), dtype="bool")
+            gb.append_op("logical_not", {"X": v}, {"Out": o}, {})
+            return o
+
+        def _and(a, b):
+            o = gb.create_var(shape=a.shape or (1,), dtype="bool")
+            gb.append_op("logical_and", {"X": a, "Y": b}, {"Out": o}, {})
+            return o
+
+        eff = self.condition
+        for prior in self.switch._prior_conds:
+            np_ = _not(prior)
+            eff = np_ if eff is None else _and(eff, np_)
+        if self.condition is not None:
+            self.switch._prior_conds.append(self.condition)
+        for op in ops:
+            if eff is not None:
+                op.attrs["_switch_cond"] = eff.name
+            gb.ops.append(op)
+            self.prog._version += 1
+        return False
+
+
+def _append_cond_block(pred, true_ops, t_outs, false_ops, f_outs):
+    """Shared cond_block lowering used by ``cond`` and ``IfElse``."""
+    gb = framework.default_main_program().global_block()
+    outs = [gb.create_var(shape=v.shape, dtype=str(v.dtype)) for v in t_outs]
+    gb.append_op(
+        "cond_block", {"Cond": pred}, {"Out": outs},
+        {"true_ops": list(true_ops), "false_ops": list(false_ops),
+         "true_out_names": [v.name for v in t_outs],
+         "false_out_names": [v.name for v in f_outs]})
+    return outs
+
+
+def cond(pred, true_fn, false_fn, name=None):
+    """Functional conditional (modern jax-style; the reference's
+    ``ConditionalBlock`` pattern is subsumed): both branches are traced
+    symbolically and lowered to lax.cond."""
+    prog = framework.default_main_program()
+    tb = prog._create_block()
+    true_out = true_fn()
+    prog._rollback()
+    fb = prog._create_block()
+    false_out = false_fn()
+    prog._rollback()
+    t_outs = true_out if isinstance(true_out, (list, tuple)) else [true_out]
+    f_outs = false_out if isinstance(false_out, (list, tuple)) else [false_out]
+    outs = _append_cond_block(pred, tb.ops, t_outs, fb.ops, f_outs)
+    return outs[0] if len(outs) == 1 else outs
+
+
+def create_array(dtype, capacity=None):
+    """TensorArray (ref ``layers/control_flow.py`` create_array /
+    ``lod_tensor_array.h``). TPU-native arrays are fixed-capacity stacked
+    buffers [capacity, ...] — static shapes for XLA; the buffer materializes
+    (zero-filled) on the first ``array_write``."""
+    gb = framework.default_main_program().current_block()
+    arr = gb.create_var(shape=None, dtype=dtype)
+    arr._tensor_array_capacity = capacity
+    return arr
+
+
+def array_write(x, i, array=None, capacity=None):
+    """Write ``x`` at position ``i`` (ref tensor_array_write). Returns the
+    array; inside a While body the write updates the loop carry in place,
+    so list the array in ``loop_vars``."""
+    if array is None:
+        array = create_array(str(x.dtype), capacity)
+    cap = capacity or getattr(array, "_tensor_array_capacity", None)
+    if cap is None:
+        raise ValueError(
+            "array_write needs a static capacity: pass capacity= here or "
+            "on create_array (TPU arrays are fixed-capacity buffers)")
+    array._tensor_array_capacity = cap
+    cb = framework.default_main_program().current_block()
+    cb.append_op("array_write", {"X": x, "I": i}, {"Out": array},
+                 {"capacity": int(cap)})
+    return array
+
+
+def array_read(array, i):
+    cb = framework.default_main_program().current_block()
+    out = cb.create_var(shape=None, dtype=str(array.dtype))
+    cb.append_op("array_read", {"Array": array, "I": i}, {"Out": out}, {})
+    return out
+
+
+def array_length(array):
+    cb = framework.default_main_program().current_block()
+    out = cb.create_var(shape=(), dtype="int64")
+    cb.append_op("array_length", {"Array": array}, {"Out": out}, {})
+    return out
+
+
+class IfElse:
+    """Ref ``layers/control_flow.py`` IfElse: two-branch construct over a
+    boolean condition. Thin sugar over ``cond`` — both branches trace to
+    lax.cond; ``input(x)`` returns x unchanged (no LoD split on TPU; the
+    predicate is a scalar)."""
+
+    def __init__(self, cond_var, name=None):
+        self._cond = cond_var
+        self._branches = {True: None, False: None}
+        self._outputs = {True: None, False: None}
+        self._in_true = None
+
+    class _Branch:
+        def __init__(self, owner, is_true):
+            self.owner = owner
+            self.is_true = is_true
+
+        def __enter__(self):
+            self.owner._in_true = self.is_true
+            prog = framework.default_main_program()
+            self.block = prog._create_block()
+            self.owner._branches[self.is_true] = self.block
+            return self.block
+
+        def __exit__(self, *a):
+            framework.default_main_program()._rollback()
+            self.owner._in_true = None
+            return False
+
+    def true_block(self):
+        return IfElse._Branch(self, True)
+
+    def false_block(self):
+        return IfElse._Branch(self, False)
+
+    def input(self, x):
+        return x
+
+    def output(self, *outs):
+        self._outputs[self._in_true] = list(outs)
+
+    def __call__(self):
+        t_outs = self._outputs[True]
+        f_outs = self._outputs[False]
+        assert t_outs and f_outs and len(t_outs) == len(f_outs), \
+            "both branches must call output() with the same arity"
+        return _append_cond_block(self._cond, self._branches[True].ops,
+                                  t_outs, self._branches[False].ops, f_outs)
+
+
+class DynamicRNN(StaticRNN):
+    """Variable-length RNN (ref ``control_flow.py`` DynamicRNN, which walks
+    LoD sequences shrinking the live batch each step).
+
+    Padded-batch redesign: same step-block recording as StaticRNN, but the
+    caller passes per-row ``lengths`` at call time; memory updates FREEZE
+    once a row's length is exhausted (so final memories equal the state at
+    each row's last valid step, matching the reference's semantics of
+    shorter sequences retiring early) and step outputs beyond a row's
+    length are zeroed.
+
+        drnn = DynamicRNN()
+        with drnn.step():                     # block() also accepted
+            x_t = drnn.step_input(x)          # x: [B, T, D]
+            h = drnn.memory(shape=[H], batch_ref=x)
+            nh = some_layers(x_t, h)
+            drnn.update_memory(h, nh)
+            drnn.step_output(nh)
+        out = drnn(lengths=seq_len)           # [B, T, H], zero-padded
+    """
+
+    def block(self):
+        return self.step()
+
+    def __call__(self, lengths=None, **kwargs):
+        if lengths is None:
+            return super().__call__(**kwargs)
+        from . import nn, tensor
+
+        prog = framework.default_main_program()
+        x_full = self._step_inputs[0][1]  # [B, T, ...]
+        seq_len = x_full.shape[1]
+
+        # [B, T] time indices as an extra scanned input (the step mask
+        # needs its own t), built in the OUTER block
+        t_row = tensor.unsqueeze(
+            tensor.range(0, seq_len, 1, "float32"), [0])   # [1, T]
+        zero_b = tensor.fill_constant_batch_size_like(
+            x_full, [1, 1], "float32", 0.0)                # [B, 1]
+        t_full = nn.elementwise_add(zero_b, t_row)         # [B, T]
+        len_f = tensor.cast(lengths, "float32")            # [B]
+
+        # inject masking ops INTO the recorded step block
+        saved_idx = prog.current_block_idx
+        prog.current_block_idx = self._block.idx
+        self._entered = True
+        try:
+            t_step = self.step_input(t_full)               # [B] per step
+            alive = tensor.cast(
+                less_than(t_step, len_f), "float32")       # [B]
+            for pre, _ in list(self._mems):
+                post = self._mem_updates[pre.name]
+                m = alive
+                for _ in range(len(post.shape) - 1):
+                    m = tensor.unsqueeze(m, [-1])
+                frozen = nn.elementwise_add(
+                    nn.elementwise_mul(post, m),
+                    nn.elementwise_mul(
+                        pre, nn.scale(m, scale=-1.0, bias=1.0)))
+                self._mem_updates[pre.name] = frozen
+            masked_outs = []
+            for o in self._step_outputs:
+                m = alive
+                for _ in range(len(o.shape) - 1):
+                    m = tensor.unsqueeze(m, [-1])
+                masked_outs.append(nn.elementwise_mul(o, m))
+            self._step_outputs = masked_outs
+        finally:
+            self._entered = False
+            prog.current_block_idx = saved_idx
+        return super().__call__(**kwargs)

@@ -1,0 +1,28 @@
+"""Operator-overload sugar for Variables (ref
+``python/paddle/fluid/layers/math_op_patch.py`` monkey_patch_variable)."""
+
+import numpy as np
+
+from ..core.framework import Variable
+
+_CMP = {"less_than", "less_equal", "greater_than", "greater_equal",
+        "equal", "not_equal"}
+
+
+def binary(x, other, op_type, reverse=False, out=None):
+    block = x.block.program.current_block()
+    if not isinstance(other, Variable):
+        from . import tensor
+
+        val = float(other)
+        other = tensor.fill_constant(
+            shape=[1], dtype=str(x.dtype), value=val)
+    a, b = (other, x) if reverse else (x, other)
+    a_shape = a.shape or ()
+    b_shape = b.shape or ()
+    out_shape = a_shape if len(a_shape) >= len(b_shape) else b_shape
+    dtype = "bool" if op_type in _CMP else str(a.dtype)
+    if out is None:
+        out = block.create_var(shape=out_shape, dtype=dtype)
+    block.append_op(op_type, {"X": a, "Y": b}, {"Out": out}, {"axis": -1})
+    return out

@@ -1,0 +1,45 @@
+"""Metric layers (ref ``python/paddle/fluid/layers/metric_op.py``)."""
+
+from ..core.layer_helper import LayerHelper
+from . import nn
+
+__all__ = ["accuracy", "auc"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    helper = LayerHelper("accuracy")
+    _, indices = nn.topk(input, k=k)
+    acc = helper.create_variable_for_type_inference(dtype="float32", shape=())
+    correct = correct or helper.create_variable_for_type_inference(
+        dtype="int32", shape=(1,))
+    total = total or helper.create_variable_for_type_inference(
+        dtype="int32", shape=(1,))
+    helper.append_op("accuracy",
+                     {"Indices": indices, "Label": label},
+                     {"Accuracy": acc, "Correct": correct, "Total": total},
+                     {})
+    return acc
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Streaming AUC with persistable histogram state (ref auc_op)."""
+    helper = LayerHelper("auc")
+    from ..core import framework
+    from . import tensor
+
+    stat_pos = tensor.create_global_var(
+        shape=(num_thresholds + 1,), value=0.0, dtype="float32",
+        persistable=True, name=helper.name + ".stat_pos")
+    stat_neg = tensor.create_global_var(
+        shape=(num_thresholds + 1,), value=0.0, dtype="float32",
+        persistable=True, name=helper.name + ".stat_neg")
+    auc_out = helper.create_variable_for_type_inference(dtype="float32",
+                                                        shape=())
+    helper.append_op(
+        "auc",
+        {"Predict": input, "Label": label, "StatPos": stat_pos,
+         "StatNeg": stat_neg},
+        {"AUC": auc_out, "StatPosOut": stat_pos, "StatNegOut": stat_neg},
+        {"num_thresholds": num_thresholds, "curve": curve})
+    return auc_out, auc_out, [stat_pos, stat_neg]

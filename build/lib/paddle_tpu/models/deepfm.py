@@ -1,0 +1,58 @@
+"""DeepFM CTR model (BASELINE config 5 — high-dim sparse; the reference
+serves this class of model through the distributed lookup table + pserver
+path, ``dist_ctr.py``/pslib. Here the embedding table carries
+``is_distributed=True`` so CompiledProgram shards it over the ``mp`` mesh
+axis — the ICI-native pserver replacement, see ``parallel/sharded_embedding``)."""
+
+from .. import layers
+from ..core.param_attr import ParamAttr
+from .common import FeedSpec, ModelSpec
+
+__all__ = ["deepfm"]
+
+
+def deepfm(sparse_feature_dim=100000, num_fields=26, embedding_size=16,
+           dense_dim=13, hidden_sizes=(400, 400, 400)):
+    feat_ids = layers.data("feat_ids", shape=[num_fields], dtype="int64")
+    dense = layers.data("dense_value", shape=[dense_dim], dtype="float32")
+    label = layers.data("label", shape=[1], dtype="int64")
+
+    # first-order term: per-feature scalar weights
+    w1 = layers.embedding(feat_ids, size=[sparse_feature_dim, 1],
+                          is_sparse=True, is_distributed=True,
+                          param_attr=ParamAttr(name="fm_w1"))
+    first_order = layers.reduce_sum(layers.squeeze(w1, [2]), dim=1,
+                                    keep_dim=True)
+
+    # second-order FM term over field embeddings [B, F, K]
+    emb = layers.embedding(feat_ids,
+                           size=[sparse_feature_dim, embedding_size],
+                           is_sparse=True, is_distributed=True,
+                           param_attr=ParamAttr(name="fm_emb"))
+    sum_sq = layers.pow(layers.reduce_sum(emb, dim=1), factor=2.0)
+    sq_sum = layers.reduce_sum(layers.pow(emb, factor=2.0), dim=1)
+    second_order = layers.scale(
+        layers.reduce_sum(layers.elementwise_sub(sum_sq, sq_sum), dim=1,
+                          keep_dim=True), scale=0.5)
+
+    # deep part: flattened embeddings + dense features -> MLP
+    deep = layers.concat(
+        [layers.reshape(emb, [-1, num_fields * embedding_size]), dense],
+        axis=1)
+    for i, h in enumerate(hidden_sizes):
+        deep = layers.fc(deep, size=h, act="relu", name="deep_fc%d" % i)
+    deep_out = layers.fc(deep, size=1, name="deep_out")
+
+    logits = layers.elementwise_add(
+        layers.elementwise_add(first_order, second_order), deep_out)
+    label_f = layers.cast(label, "float32")
+    loss = layers.mean(
+        layers.sigmoid_cross_entropy_with_logits(logits, label_f))
+    prob = layers.ops.sigmoid(logits)
+    return ModelSpec(
+        loss,
+        feeds={"feat_ids": FeedSpec([num_fields], "int64", 0,
+                                    sparse_feature_dim),
+               "dense_value": FeedSpec([dense_dim], "float32", 0.0, 1.0),
+               "label": FeedSpec([1], "int64", 0, 2)},
+        fetches={"prob": prob})

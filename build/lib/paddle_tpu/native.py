@@ -44,13 +44,9 @@ def _lib_path():
 
 def _build(lib_path):
     srcs = [os.path.join(_NATIVE_DIR, s) for s in _SRCS]
-    # compile to a pid-suffixed temp and rename: concurrent processes
-    # (shared home dirs, pytest workers) must never CDLL a half-linked .so
-    tmp = "%s.%d.tmp" % (lib_path, os.getpid())
     cmd = ["g++", "-O2", "-fPIC", "-std=c++17", "-shared", "-pthread",
-           *srcs, "-o", tmp]
+           *srcs, "-o", lib_path]
     subprocess.run(cmd, check=True, capture_output=True)
-    os.replace(tmp, lib_path)
 
 
 def _load():

@@ -1,0 +1,8 @@
+"""TPU kernels (Pallas) + their jax reference implementations.
+
+This is the ``paddle/fluid/operators/math`` + ``jit/`` analog: hand-tuned
+kernels for the hot ops. On TPU the Pallas flash-attention kernel is used;
+elsewhere (CPU tests) the pure-jax reference path runs.
+"""
+
+from . import flash_attention  # noqa: F401

@@ -1,0 +1,582 @@
+"""Optimizers (ref ``python/paddle/fluid/optimizer.py``: Optimizer base
+``:44``, ``minimize:357`` = append_backward + apply_gradients; concrete
+optimizers ``:410-1484``).
+
+Each optimizer appends symbolic update ops whose Out slots alias the state
+var names — the executor's donated-state jit gives true in-place updates on
+TPU. Accumulators (velocity/moments/...) are persistable vars initialized by
+the startup program, mirroring the reference's ``_add_accumulator``.
+"""
+
+import numpy as np
+
+from .backward import append_backward
+from .core import framework, unique_name
+from .core.framework import Variable, Parameter
+from .core.layer_helper import LayerHelper
+from .clip import append_gradient_clip_ops, ErrorClipByValue
+from .regularizer import append_regularization_ops
+
+__all__ = [
+    "SGD", "Momentum", "Adagrad", "Adam", "Adamax", "DecayedAdagrad",
+    "Adadelta", "RMSProp", "Ftrl", "Lamb", "LarsMomentum",
+    "SGDOptimizer", "MomentumOptimizer", "AdagradOptimizer", "AdamOptimizer",
+    "AdamaxOptimizer", "DecayedAdagradOptimizer", "AdadeltaOptimizer",
+    "RMSPropOptimizer", "FtrlOptimizer", "LambOptimizer",
+    "LarsMomentumOptimizer", "ModelAverage", "ExponentialMovingAverage",
+]
+
+
+class Optimizer:
+    def __init__(self, learning_rate, regularization=None, name=None):
+        self._learning_rate = learning_rate
+        self.regularization = regularization
+        self._name = name
+        self._accumulators = {}  # acc_name -> {param_name: var}
+        self._lr_var = None
+        self.helper = None
+
+    # ---- learning rate ----
+    def _create_lr_var(self, program):
+        if isinstance(self._learning_rate, Variable):
+            self._lr_var = self._learning_rate
+            return
+        if self._lr_var is not None:
+            return
+        name = unique_name.generate("learning_rate")
+        gb = program.global_block()
+        self._lr_var = gb.create_var(name=name, shape=(), dtype="float32",
+                                     persistable=True)
+        sb = framework.default_startup_program().global_block()
+        sp = sb.create_var(name=name, shape=(), dtype="float32",
+                           persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": sp},
+                     attrs={"shape": (), "dtype": "float32",
+                            "value": float(self._learning_rate)})
+
+    def _lr_for(self, param):
+        mult = 1.0
+        if isinstance(param, Parameter) and param.optimize_attr:
+            mult = param.optimize_attr.get("learning_rate", 1.0)
+        if mult == 1.0:
+            return self._lr_var
+        from .layers import nn
+        return nn.scale(self._lr_var, scale=float(mult))
+
+    # ---- accumulators ----
+    def _add_accumulator(self, name, param, fill_value=0.0, shape=None,
+                         dtype=None):
+        if name in self._accumulators and \
+                param.name in self._accumulators[name]:
+            return self._accumulators[name][param.name]
+        var_name = unique_name.generate("%s_%s" % (param.name, name))
+        shape = tuple(shape if shape is not None else param.shape)
+        dtype = dtype or str(param.dtype)
+        prog = param.block.program
+        var = prog.global_block().create_var(
+            name=var_name, shape=shape, dtype=dtype, persistable=True)
+        # accumulators lay out like their parameter on the mesh (the
+        # reference keeps optimizer state on the param's device/pserver
+        # shard; here: same PartitionSpec, so sharded optimizers stay local)
+        if tuple(shape) == tuple(param.shape):
+            var.sharding = getattr(param, "sharding", None)
+        # marks the var as optimizer state for BuildStrategy.Reduce
+        # (ZeRO-style dp-sharding of accumulators, executor._mesh_shardings)
+        var.is_optimizer_state = True
+        sb = framework.default_startup_program().global_block()
+        sp = sb.create_var(name=var_name, shape=shape, dtype=dtype,
+                           persistable=True)
+        sb.append_op("fill_constant", outputs={"Out": sp},
+                     attrs={"shape": shape, "dtype": dtype,
+                            "value": float(fill_value)})
+        self._accumulators.setdefault(name, {})[param.name] = var
+        return var
+
+    def _get_accumulator(self, name, param):
+        return self._accumulators[name][param.name]
+
+    # ---- the optimize pass ----
+    def _create_optimization_pass(self, params_grads):
+        prog = params_grads[0][0].block.program
+        self._create_lr_var(prog)
+        ops = []
+        for p, g in params_grads:
+            with framework.name_scope("optimizer"):
+                op = self._append_optimize_op(prog.global_block(), (p, g))
+                op.attrs["is_optimizer_op"] = True
+                rows = getattr(g, "sparse_rows_var", None)
+                if rows is not None:
+                    # SelectedRows-style grad: the update op takes its
+                    # scatter branch (ref sparse optimizer kernels)
+                    op.inputs["GradRows"] = [rows]
+                ops.append(op)
+        self._finish_update(prog.global_block(), params_grads)
+        return ops
+
+    def _finish_update(self, block, params_grads):
+        pass
+
+    def _append_optimize_op(self, block, param_and_grad):
+        raise NotImplementedError
+
+    def backward(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, callbacks=None):
+        return append_backward(loss, parameter_list, no_grad_set, callbacks)
+
+    def _append_grad_accumulation(self, params_grads, k):
+        """Gradient accumulation (ref ``framework/ir/multi_batch_merge_pass
+        .cc``, driven by ``dist_mnist_batch_merge.py``): raw grads sum into
+        persistable buffers for ``k`` micro-steps; downstream clip/
+        regularization/update consume the RUNNING AVERAGE, and the update
+        ops fire only on every k-th step (Switch-conditioned, so their
+        outputs revert to the previous state in between). k micro-steps of
+        batch b are numerically one step of batch k*b (mean-loss grads).
+
+        Returns (averaged params_grads, apply-condition var)."""
+        from .layers import nn as lnn
+        from .layers import tensor as ltensor
+        from .layers import control_flow as lcf
+
+        prog = params_grads[0][0].block.program
+        block = prog.global_block()
+        self._rescale_lr_decay_counter(block, k)
+        with framework.name_scope("grad_acc"):
+            counter = lnn.autoincreased_step_counter(
+                counter_name=unique_name.generate("@GRAD_ACC_COUNTER@"))
+            kvar = ltensor.fill_constant([1], "int64", k)
+            phase = block.create_var(
+                name=unique_name.generate("grad_acc_phase"),
+                shape=(1,), dtype="int64")
+            block.append_op("elementwise_mod", {"X": counter, "Y": kvar},
+                            {"Out": phase}, {})
+            apply_cond = lcf.equal(phase,
+                                   ltensor.fill_constant([1], "int64", 0))
+            # keep factor: 0.0 on apply steps (reset), 1.0 otherwise
+            not_apply = block.create_var(
+                name=unique_name.generate("grad_acc_keep"),
+                shape=(1,), dtype="bool")
+            block.append_op("logical_not", {"X": apply_cond},
+                            {"Out": not_apply}, {})
+            keep_f = ltensor.cast(not_apply, "float32")
+
+            new_pg = []
+            for p, g in params_grads:
+                rows = getattr(g, "sparse_rows_var", None)
+                # accumulator is always DENSE [p.shape]: sparse micro-step
+                # grads scatter-add into it (ref multi_batch_merge_pass.cc
+                # likewise materializes merged grads); apply steps then
+                # take the dense optimizer branch. Out-of-range sentinel
+                # rows (the sparse path's duplicate parking) drop in the
+                # scatter.
+                acc = block.create_var(
+                    name=unique_name.generate("%s@GRAD_ACC" % p.name),
+                    shape=p.shape, dtype=str(g.dtype), persistable=True)
+                sb = framework.default_startup_program().global_block()
+                sp = sb.create_var(name=acc.name, shape=p.shape,
+                                   dtype=str(g.dtype), persistable=True)
+                sb.append_op("fill_constant", outputs={"Out": sp},
+                             attrs={"shape": tuple(p.shape),
+                                    "dtype": str(g.dtype), "value": 0.0})
+                acc_sum = block.create_var(
+                    name=unique_name.generate("%s@GRAD_ACC_SUM" % p.name),
+                    shape=p.shape, dtype=str(g.dtype))
+                if rows is not None:
+                    block.append_op("scatter",
+                                    {"X": acc, "Ids": rows, "Updates": g},
+                                    {"Out": acc_sum}, {"overwrite": False})
+                else:
+                    block.append_op("elementwise_add", {"X": acc, "Y": g},
+                                    {"Out": acc_sum}, {})
+                avg = lnn.scale(acc_sum, scale=1.0 / k)
+                # write-back: keep the sum between apply steps, reset after
+                block.append_op("elementwise_mul",
+                                {"X": acc_sum, "Y": keep_f},
+                                {"Out": block.vars[acc.name]},
+                                {"axis": -1})
+                new_pg.append((p, avg))
+        return new_pg, apply_cond
+
+    def _rescale_lr_decay_counter(self, block, k):
+        """LR schedules tick their ``@LR_DECAY_COUNTER@`` once per executor
+        run; under accumulation the reference's merged program ticks once
+        per k micro-batches (``multi_batch_merge_pass.cc`` runs the
+        schedule once per merged run). Match it by rewiring every schedule
+        op to read ``ceil(counter / k)`` instead of the raw counter."""
+        from .core.framework import Operator
+
+        name = "@LR_DECAY_COUNTER@"
+        if not any(name == n for op in block.ops
+                   for n in op.output_arg_names):
+            return
+        inc_idx = max(i for i, op in enumerate(block.ops)
+                      if name in op.output_arg_names)
+        counter = block.vars[name]
+        kconst = block.create_var(
+            name=unique_name.generate("lr_counter_k"),
+            shape=(1,), dtype="int64")
+        eff = block.create_var(
+            name=unique_name.generate("lr_counter_eff"),
+            shape=(1,), dtype="int64")
+        # the schedules see a 0-based effective-step count: micro-steps
+        # t*k .. t*k+k-1 all map to effective step t, so the k-th
+        # micro-step's APPLY uses exactly the lr the merged big-batch
+        # step t would
+        new_ops = [
+            Operator(block, "fill_constant", None, {"Out": kconst},
+                     {"shape": (1,), "dtype": "int64", "value": float(k)}),
+            Operator(block, "elementwise_floordiv",
+                     {"X": counter, "Y": kconst}, {"Out": eff}, {}),
+        ]
+        inc_op = block.ops[inc_idx]
+        for j, op in enumerate(new_ops):
+            block.ops.insert(inc_idx + 1 + j, op)
+        # rewire downstream readers (the schedule's cast/pow/... chain)
+        for op in block.ops[inc_idx + 1 + len(new_ops):]:
+            for slot, vs in op.inputs.items():
+                op.inputs[slot] = [eff if v.name == name else v for v in vs]
+        # the backward replay runs the autodiff op's CAPTURED fwd_ops list
+        # (same Operator objects, separate list) — mirror the insertion
+        # there or the rewired readers see an undefined var in the replay
+        for op in block.ops:
+            if op.type != "autodiff":
+                continue
+            fwd = op.attrs.get("fwd_ops") or []
+            for i, f in enumerate(fwd):
+                if f is inc_op:
+                    op.attrs["fwd_ops"] = (fwd[:i + 1] + new_ops
+                                           + fwd[i + 1:])
+                    break
+        block.program._version += 1
+
+    def apply_gradients(self, params_grads, accumulate_steps=None):
+        apply_cond = None
+        if accumulate_steps is not None and accumulate_steps > 1:
+            params_grads, apply_cond = self._append_grad_accumulation(
+                params_grads, int(accumulate_steps))
+        params_grads = append_gradient_clip_ops(params_grads)
+        params_grads = append_regularization_ops(params_grads,
+                                                 self.regularization)
+        self._final_params_grads = params_grads
+        block = params_grads[0][0].block.program.global_block()
+        n0 = len(block.ops)
+        ops = self._create_optimization_pass(params_grads)
+        if apply_cond is not None:
+            # Guard EVERY persistable-state write appended by the pass
+            # (update ops AND _finish_update extras like Adamax's beta-pow
+            # scale, ModelAverage/EMA accumulators) — anything less lets
+            # auxiliary state advance per micro-step
+            for op in block.ops[n0:]:
+                for vs in op.outputs.values():
+                    if any(getattr(v, "persistable", False) for v in vs):
+                        op.attrs["_switch_cond"] = apply_cond.name
+                        break
+        return ops
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None, accumulate_steps=None):
+        params_grads = self.backward(loss, startup_program, parameter_list,
+                                     no_grad_set)
+        optimize_ops = self.apply_gradients(params_grads, accumulate_steps)
+        # return the post-clip/regularization pairs (what the update ops
+        # actually consume) — more useful than the raw backward outputs
+        return optimize_ops, self._final_params_grads
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        return block.append_op(
+            "sgd",
+            {"Param": p, "Grad": g, "LearningRate": self._lr_for(p)},
+            {"ParamOut": p}, {})
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate, momentum, use_nesterov=False,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._add_accumulator("velocity", p)
+        return block.append_op(
+            "momentum",
+            {"Param": p, "Grad": g, "Velocity": v,
+             "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "VelocityOut": v},
+            {"mu": self._momentum, "use_nesterov": self._use_nesterov})
+
+
+class LarsMomentum(Optimizer):
+    """LARS (ref ``lars_momentum_op.cc`` / LarsMomentumOptimizer)."""
+
+    def __init__(self, learning_rate, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_weight_decay = lars_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        v = self._add_accumulator("velocity", p)
+        return block.append_op(
+            "lars_momentum",
+            {"Param": p, "Grad": g, "Velocity": v,
+             "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "VelocityOut": v},
+            {"mu": self._momentum, "lars_coeff": self._lars_coeff,
+             "lars_weight_decay": self._lars_weight_decay})
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, regularization=None,
+                 name=None, initial_accumulator_value=0.0):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p, fill_value=self._init_acc)
+        return block.append_op(
+            "adagrad",
+            {"Param": p, "Grad": g, "Moment": m,
+             "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "MomentOut": m}, {"epsilon": self._epsilon})
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None,
+                 lazy_mode=False):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, self._beta1,
+                                    shape=(1,))
+        b2p = self._add_accumulator("beta2_pow_acc", p, self._beta2,
+                                    shape=(1,))
+        return block.append_op(
+            "adam",
+            {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+             "Beta1Pow": b1p, "Beta2Pow": b2p,
+             "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+             "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p)
+        inf = self._add_accumulator("inf_norm", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, self._beta1,
+                                    shape=(1,))
+        return block.append_op(
+            "adamax",
+            {"Param": p, "Grad": g, "Moment": m, "InfNorm": inf,
+             "Beta1Pow": b1p, "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "MomentOut": m, "InfNormOut": inf},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon})
+
+    def _finish_update(self, block, params_grads):
+        for p, _ in params_grads:
+            b1p = self._get_accumulator("beta1_pow_acc", p)
+            op = block.append_op(
+                "scale", {"X": b1p}, {"Out": b1p}, {"scale": self._beta1})
+            op.attrs["is_optimizer_op"] = True
+
+
+class DecayedAdagrad(Optimizer):
+    def __init__(self, learning_rate, decay=0.95, epsilon=1e-6,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._decay, self._epsilon = decay, epsilon
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m = self._add_accumulator("moment", p)
+        return block.append_op(
+            "decayed_adagrad",
+            {"Param": p, "Grad": g, "Moment": m,
+             "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "MomentOut": m},
+            {"decay": self._decay, "epsilon": self._epsilon})
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, rho=0.95,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        g2 = self._add_accumulator("avg_squared_grad", p)
+        u2 = self._add_accumulator("avg_squared_update", p)
+        return block.append_op(
+            "adadelta",
+            {"Param": p, "Grad": g, "AvgSquaredGrad": g2,
+             "AvgSquaredUpdate": u2, "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "AvgSquaredGradOut": g2,
+             "AvgSquaredUpdateOut": u2},
+            {"epsilon": self._epsilon, "rho": self._rho})
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        ms = self._add_accumulator("mean_square", p)
+        mg = self._add_accumulator("mean_grad", p)
+        mom = self._add_accumulator("momentum", p)
+        return block.append_op(
+            "rmsprop",
+            {"Param": p, "Grad": g, "MeanSquare": ms, "MeanGrad": mg,
+             "Moment": mom, "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "MeanSquareOut": ms, "MeanGradOut": mg,
+             "MomentOut": mom},
+            {"decay": self._rho, "epsilon": self._epsilon,
+             "momentum": self._momentum, "centered": self._centered})
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 regularization=None, name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        sq = self._add_accumulator("squared", p)
+        lin = self._add_accumulator("linear", p)
+        return block.append_op(
+            "ftrl",
+            {"Param": p, "Grad": g, "SquaredAccumulator": sq,
+             "LinearAccumulator": lin, "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "SquaredAccumOut": sq, "LinearAccumOut": lin},
+            {"l1": self._l1, "l2": self._l2, "lr_power": self._lr_power})
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, regularization=None,
+                 name=None):
+        super().__init__(learning_rate, regularization, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+
+    def _append_optimize_op(self, block, param_and_grad):
+        p, g = param_and_grad
+        m1 = self._add_accumulator("moment1", p)
+        m2 = self._add_accumulator("moment2", p)
+        b1p = self._add_accumulator("beta1_pow_acc", p, self._beta1,
+                                    shape=(1,))
+        b2p = self._add_accumulator("beta2_pow_acc", p, self._beta2,
+                                    shape=(1,))
+        return block.append_op(
+            "lamb",
+            {"Param": p, "Grad": g, "Moment1": m1, "Moment2": m2,
+             "Beta1Pow": b1p, "Beta2Pow": b2p,
+             "LearningRate": self._lr_for(p)},
+            {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
+             "Beta1PowOut": b1p, "Beta2PowOut": b2p},
+            {"beta1": self._beta1, "beta2": self._beta2,
+             "epsilon": self._epsilon, "weight_decay": self._wd})
+
+
+class ModelAverage(Optimizer):
+    """Sliding-window parameter averaging (ref ``optimizer.py`` ModelAverage).
+    Maintains a running sum accumulator per param; ``apply()`` swaps params
+    for their average, ``restore()`` swaps back."""
+
+    def __init__(self, average_window_rate=0.15, min_average_window=10000,
+                 max_average_window=10000, regularization=None, name=None):
+        super().__init__(0.0, regularization, name)
+        self._max_window = max_average_window
+
+    def _append_average_ops(self, program):
+        gb = program.global_block()
+        ops = []
+        for p in program.all_parameters():
+            acc = self._add_accumulator("sum", p)
+            cnt = self._add_accumulator("cnt", p, shape=(1,))
+            op1 = gb.append_op("elementwise_add", {"X": acc, "Y": p},
+                               {"Out": acc}, {"is_optimizer_op": True})
+            op2 = gb.append_op("increment", {"X": cnt}, {"Out": cnt},
+                               {"step": 1.0, "is_optimizer_op": True})
+            ops += [op1, op2]
+        return ops
+
+    def minimize(self, loss, **kwargs):
+        raise TypeError("ModelAverage wraps another optimizer's program; "
+                        "call _append_average_ops after minimize")
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (capability extension; standard for TPU training)."""
+
+    def __init__(self, decay=0.999, name=None):
+        self._decay = decay
+        self._name = name or "ema"
+        self._shadows = {}
+
+    def update(self, program=None):
+        program = program or framework.default_main_program()
+        gb = program.global_block()
+        sb = framework.default_startup_program().global_block()
+        for p in program.all_parameters():
+            name = "%s.%s" % (p.name, self._name)
+            shadow = gb.create_var(name=name, shape=p.shape,
+                                   dtype=str(p.dtype), persistable=True)
+            sp = sb.create_var(name=name, shape=p.shape, dtype=str(p.dtype),
+                               persistable=True)
+            sb.append_op("fill_constant", outputs={"Out": sp},
+                         attrs={"shape": p.shape, "dtype": str(p.dtype),
+                                "value": 0.0})
+            # shadow = decay*shadow + (1-decay)*param
+            tmp_sh = gb.append_op(
+                "scale", {"X": shadow}, {"Out": shadow},
+                {"scale": self._decay, "is_optimizer_op": True})
+            from .layers import nn
+            scaled_p = nn.scale(p, scale=1.0 - self._decay)
+            gb.append_op("elementwise_add", {"X": shadow, "Y": scaled_p},
+                         {"Out": shadow}, {"is_optimizer_op": True})
+            self._shadows[p.name] = shadow
+
+
+# reference-style aliases
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+DecayedAdagradOptimizer = DecayedAdagrad
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+FtrlOptimizer = Ftrl
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum

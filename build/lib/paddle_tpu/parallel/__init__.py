@@ -1,0 +1,20 @@
+"""Distributed / multi-device training (ref §2.5 of SURVEY.md).
+
+The reference's planes — ParallelExecutor+NCCL (data parallel),
+DistributeTranspiler+gRPC (parameter server), gen_nccl_id bootstrap — map to
+TPU-native primitives:
+
+  * device mesh + sharding specs (``mesh.py``) — dp/mp/pp/sp/ep axes
+  * data parallel: batch-axis sharding, GSPMD-inserted gradient allreduce
+  * "pserver" sharded parameters: embedding tables sharded over the mesh,
+    lookups via all-to-all (``sharded_embedding.py``)
+  * multi-host bootstrap: jax.distributed coordination (``env.py``)
+  * sequence parallelism: ring attention over ppermute (``ring_attention.py``)
+"""
+
+from .mesh import (  # noqa: F401
+    make_mesh, get_mesh, set_mesh, mesh_scope, DistStrategy)
+from .transpiler import DistributeTranspiler, DistributeTranspilerConfig  # noqa: F401
+from . import sharded_embedding  # noqa: F401
+from . import ring_attention  # noqa: F401
+from . import env  # noqa: F401

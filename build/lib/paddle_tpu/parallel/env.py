@@ -1,0 +1,50 @@
+"""Multi-host bootstrap (ref ``gen_nccl_id_op.cc`` + ``PADDLE_TRAINER_*``
+env protocol + ``python/paddle/distributed/launch.py``).
+
+TPU-native: jax.distributed coordination service. Reads the reference's env
+var names so launch scripts port directly."""
+
+import os
+
+import jax
+
+__all__ = ["init_distributed", "trainer_id", "trainer_num", "is_initialized"]
+
+_initialized = False
+
+
+def trainer_id():
+    return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def trainer_num():
+    eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+    if eps:
+        return len(eps.split(","))
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def is_initialized():
+    return _initialized
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Form the multi-host world (≡ gen_nccl_id broadcast + ncclCommInitRank
+    ``nccl_helper.h:104-133``). Endpoint 0 doubles as the coordinator, like
+    trainer 0 generating the NCCL id."""
+    global _initialized
+    if _initialized:
+        return
+    num_processes = num_processes or trainer_num()
+    if num_processes <= 1:
+        _initialized = True
+        return
+    if coordinator_address is None:
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        coordinator_address = eps[0]
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id if process_id is not None else trainer_id())
+    _initialized = True

@@ -1,0 +1,74 @@
+"""Mixture-of-Experts FFN with expert parallelism over an ``ep`` mesh axis.
+
+Absent from the 2019 reference (SURVEY.md §2.5D: "Expert parallelism / MoE —
+no") but first-class here. TPU-native design (GShard-style): top-k token-
+choice gating with a static capacity, dispatch/combine expressed as dense
+einsums — the expert dimension of the weights carries a ``('ep', ...)``
+sharding spec, so GSPMD lowers the dispatch einsum to an all-to-all over ICI
+(no manual collectives; static shapes throughout).
+"""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["moe_dispatch", "moe_ffn_apply"]
+
+
+def moe_dispatch(gate_logits, k=2, capacity_factor=1.25):
+    """Top-k gating with static expert capacity.
+
+    gate_logits: [T, E]. Returns (dispatch [T, E, C] one-hot, combine
+    [T, E, C] weights, aux_loss scalar). Tokens over capacity are dropped
+    (their combine weights are 0) — the standard static-shape formulation.
+    """
+    t, e = gate_logits.shape
+    c = max(1, int(capacity_factor * k * t / e))
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+
+    # load-balancing auxiliary loss (Shazeer et al.): mean prob * mean
+    # assignment fraction per expert
+    top1 = jnp.argmax(probs, axis=-1)
+    frac_tokens = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(frac_tokens * frac_probs)
+
+    dispatch = jnp.zeros((t, e, c), jnp.float32)
+    combine = jnp.zeros((t, e, c), jnp.float32)
+    masked = probs
+    used = jnp.zeros((e,), jnp.float32)  # slots consumed in earlier rounds
+    for _ in range(k):
+        choice = jnp.argmax(masked, axis=-1)  # [T]
+        gate = jnp.take_along_axis(masked, choice[:, None], axis=-1)[:, 0]
+        onehot = jax.nn.one_hot(choice, e, dtype=jnp.float32)  # [T, E]
+        # position within the chosen expert's buffer, offset by the slots
+        # already filled in previous rounds (GShard formulation — without
+        # the offset, round-2 tokens collide with round-1 slots)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0 + used[None, :]) * onehot
+        pos_id = jnp.sum(pos, axis=-1).astype(jnp.int32)  # [T]
+        in_cap = (pos_id < c).astype(jnp.float32)
+        slot = jax.nn.one_hot(pos_id, c, dtype=jnp.float32)  # [T, C]
+        d = onehot[:, :, None] * slot[:, None, :] * in_cap[:, None, None]
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        used = used + jnp.sum(onehot, axis=0)
+        masked = masked * (1.0 - onehot)  # exclude chosen expert next round
+    return dispatch, combine, aux_loss
+
+
+def moe_ffn_apply(x, gate_w, w1, b1, w2, b2, k=2, capacity_factor=1.25,
+                  activation=jax.nn.relu):
+    """MoE feed-forward. x: [..., D]; gate_w: [D, E]; w1: [E, D, F];
+    w2: [E, F, D]. Returns (out [..., D], aux_loss)."""
+    lead = x.shape[:-1]
+    d = x.shape[-1]
+    xt = x.reshape(-1, d)  # [T, D]
+    logits = xt @ gate_w
+    dispatch, combine, aux = moe_dispatch(logits, k, capacity_factor)
+    # dispatch tokens to expert buffers: [E, C, D] — with w1/w2 sharded on
+    # the expert axis, GSPMD turns this einsum into the a2a dispatch
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), xt)
+    h = activation(jnp.einsum("ecd,edf->ecf", expert_in, w1)
+                   + b1[:, None, :])
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w2) + b2[:, None, :]
+    out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
+    return out.reshape(lead + (d,)), aux

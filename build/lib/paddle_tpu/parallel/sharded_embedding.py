@@ -1,0 +1,64 @@
+"""Sharded embedding lookup — the pserver / distributed-lookup-table analog.
+
+Reference: params sliced across pservers (``distribute_transpiler.py:84``
+slice_variable), trainers pull rows via RPC prefetch
+(``operators/distributed/parameter_prefetch.cc``). TPU-native: the table is
+row-sharded over a mesh axis; the lookup runs under shard_map — each shard
+gathers its local rows and a psum merges partial rows (one ICI collective,
+no RPC plane).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..core.op_registry import register, get, put
+
+__all__ = ["sharded_lookup"]
+
+
+def sharded_lookup(table, ids, mesh, axis="mp"):
+    """table: [V, D] sharded (axis, None); ids: [...] int32 global ids.
+    Returns [..., D] rows. psum-of-partials formulation: each shard
+    contributes rows it owns, zeros elsewhere — one reduce over the axis."""
+    from jax.experimental.shard_map import shard_map
+
+    n_shards = mesh.shape[axis]
+    v = table.shape[0]
+    rows_per = v // n_shards
+
+    def local_lookup(tab, ids_):
+        idx = jax.lax.axis_index(axis)
+        lo = idx * rows_per
+        local = ids_ - lo
+        mask = (local >= 0) & (local < rows_per)
+        safe = jnp.clip(local, 0, rows_per - 1)
+        rows = jnp.take(tab, safe, axis=0)
+        rows = rows * mask[..., None].astype(rows.dtype)
+        return jax.lax.psum(rows, axis)
+
+    return shard_map(
+        local_lookup, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P(),
+    )(table, ids)
+
+
+@register("sharded_lookup_table")
+def _sharded_lookup_op(env, op):
+    """Symbolic op form used when a program is transpiled with
+    sharded_embeddings: falls back to plain gather when no mesh is active
+    (single chip), so programs are portable."""
+    w = get(env, op.input("W"))
+    ids = get(env, op.input("Ids")).astype(jnp.int32)
+    if ids.ndim >= 2 and ids.shape[-1] == 1:
+        ids = ids.squeeze(-1)
+    from .mesh import get_mesh
+
+    mesh = get_mesh()
+    axis = op.attr("mesh_axis", "mp")
+    if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
+        out = sharded_lookup(w, ids, mesh, axis)
+    else:
+        out = jnp.take(w, ids, axis=0)
+    put(env, op.output("Out"), out)

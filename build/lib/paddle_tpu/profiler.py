@@ -1,0 +1,85 @@
+"""Profiler (ref ``python/paddle/fluid/profiler.py`` +
+``platform/profiler.h`` + CUPTI ``device_tracer.h`` + ``tools/timeline.py``).
+
+TPU-native: jax.profiler XPlane traces (viewable in TensorBoard/Perfetto —
+the chrome-trace parity) + a lightweight host-event aggregator giving the
+reference's sorted-table report."""
+
+import contextlib
+import time
+from collections import defaultdict
+
+import jax
+
+__all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
+           "record_event"]
+
+_events = defaultdict(lambda: [0.0, 0])  # name -> [total_s, count]
+_trace_dir = None
+_enabled = False
+
+
+def start_profiler(state="All", trace_dir=None):
+    global _enabled, _trace_dir
+    _enabled = True
+    _trace_dir = trace_dir
+    if trace_dir:
+        jax.profiler.start_trace(trace_dir)
+
+
+def stop_profiler(sorted_key="total", profile_path=None):
+    global _enabled
+    _enabled = False
+    if _trace_dir:
+        jax.profiler.stop_trace()
+    report = _report(sorted_key)
+    if profile_path:
+        with open(profile_path, "w") as f:
+            f.write(report)
+    else:
+        print(report)
+    return report
+
+
+def reset_profiler():
+    _events.clear()
+
+
+def _report(sorted_key="total"):
+    lines = ["%-40s %10s %12s %12s" % ("Event", "Calls", "Total(ms)",
+                                       "Avg(ms)")]
+    items = list(_events.items())
+    if sorted_key == "total":
+        items.sort(key=lambda kv: -kv[1][0])
+    elif sorted_key == "calls":
+        items.sort(key=lambda kv: -kv[1][1])
+    for name, (total, count) in items:
+        lines.append("%-40s %10d %12.3f %12.3f"
+                     % (name, count, total * 1e3,
+                        total * 1e3 / max(count, 1)))
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def record_event(name):
+    """RAII host event (ref ``RecordEvent`` ``profiler.h:41``); also opens a
+    jax.named_scope so the device trace carries the same label."""
+    t0 = time.perf_counter()
+    try:
+        with jax.named_scope(name.replace("/", "_")):
+            yield
+    finally:
+        if _enabled:
+            ev = _events[name]
+            ev[0] += time.perf_counter() - t0
+            ev[1] += 1
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key="total", profile_path=None,
+             trace_dir=None):
+    start_profiler(state, trace_dir)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

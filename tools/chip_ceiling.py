@@ -14,6 +14,7 @@ Usage: python tools/chip_ceiling.py [--out CHIP_CEILING.json]
 
 import argparse
 import json
+import os
 import time
 
 import numpy as np
@@ -84,7 +85,13 @@ def main():
     ap.add_argument("--out", default="CHIP_CEILING.json")
     args = ap.parse_args()
 
+    import sys
+
     import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from bench import _peak_flops  # the per-chip bf16 peak table
 
     dev = jax.devices()[0]
     result = {
@@ -94,8 +101,8 @@ def main():
             matmul_ceiling(jax.numpy.bfloat16) / 1e12, 1),
         "int8_matmul_tops": None,  # dot(int8) unsupported via this path
         "hbm_stream_gbs": round(hbm_ceiling() / 1e9, 1),
-        "nominal_bf16_tflops": 197.0,  # v5e bf16 peak (394 is int8 TOPS)
-        "nominal_hbm_gbs": 819.0,
+        "nominal_bf16_tflops": round(_peak_flops(dev) / 1e12, 1),
+        "nominal_hbm_gbs": 819.0,  # v5e spec; informational only
     }
     result["fraction_of_nominal_matmul"] = round(
         result["bf16_matmul_tflops"] / result["nominal_bf16_tflops"], 3)
