@@ -20,22 +20,25 @@ namespace recordio {
 constexpr uint32_t kMagic = 0x7061646cu;  // "padl"
 
 // ---- crc32 (IEEE, table-driven) ----
-static uint32_t crc_table[256];
-static bool crc_init_done = false;
-
-static void CrcInit() {
-  if (crc_init_done) return;
-  for (uint32_t i = 0; i < 256; ++i) {
-    uint32_t c = i;
-    for (int k = 0; k < 8; ++k)
-      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
-    crc_table[i] = c;
-  }
-  crc_init_done = true;
+// function-local static: C++11 guarantees thread-safe one-time init
+// (prefetch worker threads compute CRCs concurrently)
+static const uint32_t* CrcTable() {
+  static const struct Table {
+    uint32_t v[256];
+    Table() {
+      for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; ++k)
+          c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+        v[i] = c;
+      }
+    }
+  } table;
+  return table.v;
 }
 
 static uint32_t Crc32(const uint8_t* buf, size_t len) {
-  CrcInit();
+  const uint32_t* crc_table = CrcTable();
   uint32_t c = 0xFFFFFFFFu;
   for (size_t i = 0; i < len; ++i)
     c = crc_table[(c ^ buf[i]) & 0xFF] ^ (c >> 8);

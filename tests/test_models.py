@@ -158,3 +158,100 @@ def test_book_models_train():
                       for _ in range(6)]
         assert np.isfinite(losses).all(), (builder.__name__, losses)
         assert losses[-1] < losses[0], (builder.__name__, losses)
+
+
+# ---------------------------------------------------------------------------
+# Held-out quality bars (VERDICT r3 ask #5) — the analog of the reference
+# book tests' quality asserts (``tests/book/test_recognize_digits.py``
+# trains to an error bar, not just "loss decreased"): train on structured
+# synthetic data, evaluate on HELD-OUT samples via clone(for_test=True),
+# and assert the eval loss clears a chance-level bar.
+# ---------------------------------------------------------------------------
+
+
+def _quality_run(build, make_batch, train_steps, bar, lr=3e-3, bs=16):
+    fluid.unique_name.switch()
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 1234
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        spec = build()
+        test_prog = main.clone(for_test=True)
+        fluid.optimizer.Adam(learning_rate=lr).minimize(spec.loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        for _ in range(train_steps):
+            exe.run(main, feed=make_batch(rng, bs),
+                    fetch_list=[spec.loss])
+        # held-out: fresh samples from the same task distribution
+        held_rng = np.random.RandomState(999)
+        evs = [float(exe.run(test_prog, feed=make_batch(held_rng, bs),
+                             fetch_list=[spec.loss])[0])
+               for _ in range(4)]
+    ev = float(np.mean(evs))
+    assert np.isfinite(ev) and ev < bar, (evs, "bar", bar)
+    return ev
+
+
+def test_transformer_heldout_quality():
+    """Reverse-copy translation: held-out eval loss must beat chance
+    (ln 32 = 3.47) by >2x after a short training run."""
+    V, T = 32, 12
+
+    def build():
+        return models.transformer.transformer_base(
+            src_vocab=V, trg_vocab=V, seq_len=T, d_model=32, d_ff=64,
+            n_head=2, n_layer=2, dropout_rate=0.0, label_smooth_eps=0.0)
+
+    def make_batch(rng, bs):
+        src = rng.randint(2, V, (bs, T)).astype("int64")
+        lbl = src[:, ::-1].copy()
+        trg = np.concatenate([np.ones((bs, 1), "int64"), lbl[:, :-1]],
+                             axis=1)
+        return {"src_ids": src, "trg_ids": trg, "lbl_ids": lbl,
+                "src_len": np.full((bs,), T, "int64"),
+                "trg_len": np.full((bs,), T, "int64")}
+
+    _quality_run(build, make_batch, train_steps=400, bar=np.log(32) / 2,
+                 lr=5e-3)
+
+
+def test_resnet_cifar_heldout_quality():
+    """4-way pattern classification: held-out eval loss far below chance
+    (ln 4 = 1.39)."""
+    def build():
+        return models.resnet.resnet_cifar10(depth=8, class_num=4)
+
+    def make_batch(rng, bs):
+        label = rng.randint(0, 4, (bs, 1)).astype("int64")
+        img = rng.randn(bs, 3, 32, 32).astype("float32") * 0.25
+        # class-dependent quadrant brightness pattern
+        for i, c in enumerate(label[:, 0]):
+            img[i, :, (c // 2) * 16:(c // 2) * 16 + 16,
+                (c % 2) * 16:(c % 2) * 16 + 16] += 1.0
+        return {"img": img, "label": label}
+
+    _quality_run(build, make_batch, train_steps=60, bar=np.log(4) / 2,
+                 lr=2e-3, bs=16)
+
+
+def test_word2vec_heldout_quality():
+    """Deterministic n-gram rule (next = f(first context word)): held-out
+    loss far below chance (ln 40 = 3.69)."""
+    V, W = 40, 4
+
+    def build():
+        return models.word2vec.ngram_lm(dict_size=V, emb_dim=16,
+                                        hidden_size=32, window=W)
+
+    def make_batch(rng, bs):
+        ctx = rng.randint(0, V, (bs, W)).astype("int64")
+        # lookup rule: next word determined by the first context word
+        nxt = ((ctx[:, 0] + 1) % V).astype("int64")[:, None]
+        feed = {"w%d" % i: ctx[:, i:i + 1] for i in range(W)}
+        feed["next_word"] = nxt
+        return feed
+
+    _quality_run(build, make_batch, train_steps=200, bar=np.log(40) / 2,
+                 lr=5e-3, bs=32)
