@@ -72,6 +72,14 @@ def check_grad(build_fn, feed, wrt_names, atol=5e-3, rtol=5e-3, delta=1e-3):
     feed: dict name->np.float32 arrays; wrt_names ⊆ feed keys.
     """
     loss = build_fn()
+    # deterministic init: an unseeded startup draws from secrets.randbits,
+    # making finite-difference tolerances init-dependent (test_nce_grad
+    # failed ~1-in-N full-suite runs before this). The FIRST run seeds the
+    # scope RNG from the STARTUP program's seed, so guard/set that one.
+    if not fluid.default_startup_program().random_seed:
+        fluid.default_startup_program().random_seed = 1234
+        if not default_main_program().random_seed:
+            default_main_program().random_seed = 1234
     grads = fluid.calc_gradient(
         loss, [default_main_program().global_block().var(n)
                for n in wrt_names])
