@@ -152,8 +152,16 @@ def _prelu(env, op):
 
 @register("gelu")
 def _gelu(env, op):
-    approx = op.attr("approximate", False)
-    put(env, op.output("Out"), jax.nn.gelu(get(env, op.input("X")), approximate=approx))
+    from ..op_registry import amp_enabled, env_flag
+    # tanh-approx under AMP (the standard TPU BERT choice): erf's
+    # polynomial lowering costs ~0.9 ms/layer at BERT-base shapes and its
+    # vjp chain re-fuses into dW matmul operands (NOTES_r4.md); exact erf
+    # stays the default for f32 runs and under PADDLE_TPU_AMP_F32_ACTS
+    approx = op.attr("approximate",
+                     amp_enabled()
+                     and not env_flag("PADDLE_TPU_AMP_F32_ACTS"))
+    put(env, op.output("Out"),
+        jax.nn.gelu(get(env, op.input("X")), approximate=approx))
 
 
 @register("brelu")

@@ -47,7 +47,12 @@ class FC(Layer):
                 w, b = w.astype(xv.dtype), b.astype(xv.dtype)
             xv2 = xv.reshape(int(np.prod(xv.shape[:nfd])), -1)
             out = (xv2 @ w + b).reshape(tuple(xv.shape[:nfd]) + (size,))
-            if act:
+            if act == "gelu":
+                # tanh-approx for bf16 activations, matching the static
+                # op-registry AMP policy (opimpl/math_ops.py:_gelu)
+                out = jax.nn.gelu(out,
+                                  approximate=xv.dtype == jnp.bfloat16)
+            elif act:
                 out = getattr(jax.nn, act)(out) if hasattr(jax.nn, act) \
                     else getattr(jnp, act)(out)
             return out

@@ -435,8 +435,12 @@ def elu(x, alpha=1.0, name=None):
     return _unary_layer("elu", x, {"alpha": alpha}, name)
 
 
-def gelu(x, approximate=False, name=None):
-    return _unary_layer("gelu", x, {"approximate": approximate}, name)
+def gelu(x, approximate=None, name=None):
+    """``approximate=None`` (default) lets the op pick: exact erf in f32,
+    tanh-approx under AMP (see ``opimpl/math_ops.py:_gelu``). Pass an
+    explicit bool to pin the form."""
+    attrs = {} if approximate is None else {"approximate": approximate}
+    return _unary_layer("gelu", x, attrs, name)
 
 
 def swish(x, beta=1.0, name=None):
