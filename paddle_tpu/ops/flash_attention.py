@@ -102,9 +102,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
     bh_idx = pl.program_id(0)
     q_idx = pl.program_id(1)
 
-    m_i = jnp.full((block_q,), -jnp.inf, jnp.float32)
-    l_i = jnp.zeros((block_q,), jnp.float32)
-    acc = jnp.zeros((block_q, d), jnp.float32)
+    # TRANSPOSED scores [bk, bq] (cf. _dense_fwd_kernel): the online
+    # max/sum run over SUBLANES (vreg adds, no cross-lane shuffles) and
+    # the running per-query stats are [1, bq] LANE vectors that broadcast
+    # for free; the accumulator is kept transposed [d, bq] so its
+    # per-iteration rescale is also a lane-broadcast. One [d, bq]
+    # transpose per PROGRAM at the end, instead of lane reductions per
+    # k-block iteration.
+    m_i = jnp.full((1, block_q), -jnp.inf, jnp.float32)
+    l_i = jnp.zeros((1, block_q), jnp.float32)
+    acc = jnp.zeros((d, block_q), jnp.float32)
 
     num_kb = kv_pad // block_k
     if causal:
@@ -116,42 +123,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
         m_i, l_i, acc = carry
         k = k_ref[pl.dslice(kb * block_k, block_k), :]
         v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bk, bq]
         if bias_ref is not None:
             b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
-            s = s + b[None, :].astype(jnp.float32)
+            st = st + b.astype(jnp.float32)[:, None]
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
+            jnp.int32, (block_k, block_q), 0)
         mask = k_pos < kv_len
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
+                jnp.int32, (block_k, block_q), 1)
             mask = mask & (q_pos >= k_pos)
-        s = jnp.where(mask, s, -jnp.inf)
-        m_new = jnp.maximum(m_i, jnp.max(s, axis=1))
+        st = jnp.where(mask, st, -jnp.inf)
+        m_new = jnp.maximum(m_i, jnp.max(st, axis=0, keepdims=True))
         m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.where(mask, jnp.exp(s - m_safe[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(st - m_safe), 0.0)
         alpha = jnp.where(jnp.isfinite(m_i), jnp.exp(m_i - m_safe), 0.0)
-        l_new = alpha * l_i + jnp.sum(p, axis=1)
+        l_new = alpha * l_i + jnp.sum(p, axis=0, keepdims=True)
         p_use = p
         if dropout_rate > 0.0:
-            keep = _dropout_keep((block_q, block_k), dropout_rate,
+            keep = _dropout_keep((block_k, block_q), dropout_rate,
                                  seed_ref[0, 0], (bh_idx, q_idx, kb))
             p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p_use.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            v, p_use.astype(v.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [d, bq]
         return m_new, l_new, acc_new
 
     m_i, l_i, acc = jax.lax.fori_loop(0, num_kb, body, (m_i, l_i, acc))
     l_safe = jnp.maximum(l_i, 1e-30)
-    o_ref[...] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    o_ref[...] = (acc / l_safe).T.astype(o_ref.dtype)
     # row logsumexp for the backward's prob recomputation; the stats ref
     # holds the FULL row axis (Mosaic-friendly layout), sliced per program
     lse = jnp.where(jnp.isfinite(m_i), m_i + jnp.log(l_safe), -jnp.inf)
-    lse_ref[0, pl.dslice(q_idx * block_q, block_q)] = lse.astype(jnp.float32)
+    lse_ref[0, pl.dslice(q_idx * block_q, block_q)] = \
+        lse[0].astype(jnp.float32)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
@@ -174,33 +182,35 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
 
     def body(kb, dq):
+        # TRANSPOSED scores [bk, bq]: per-query lse/delta broadcast along
+        # LANES; dropout regenerates in the same layout as the fwd
         k = k_ref[pl.dslice(kb * block_k, block_k), :]
         v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
             b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
-            s = s + b[None, :].astype(jnp.float32)
+            st = st + b.astype(jnp.float32)[:, None]
         k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
+            jnp.int32, (block_k, block_q), 0)
         mask = k_pos < kv_len
         if causal:
             q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
+                jnp.int32, (block_k, block_q), 1)
             mask = mask & (q_pos >= k_pos)
-        p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
-                      0.0) * lse_okf[:, None]
+        p = jnp.where(mask, jnp.exp(st - lse_safe[None, :]),
+                      0.0) * lse_okf[None, :]
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bq, bk] = dO V^T
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, bq] = V dO^T
         if dropout_rate > 0.0:
-            keep = _dropout_keep((block_q, block_k), dropout_rate,
+            keep = _dropout_keep((block_k, block_q), dropout_rate,
                                  seed_ref[0, 0], (bh_idx, q_idx, kb))
             dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta[:, None])  # [bq, bk]
+        ds = p * (dp - delta[None, :])  # [bk, bq]
         dq = dq + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         return dq
 
@@ -225,8 +235,11 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     bh_idx = pl.program_id(0)
     k_idx = pl.program_id(1)
 
+    # TRANSPOSED scores [bk, bq] (cf. _fwd_kernel): per-query lse/delta
+    # broadcast along lanes; the per-key bias-grad reduction rides the
+    # MXU as a ones-column dot instead of a per-iteration lane reduce
     k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+        jnp.int32, (block_k, block_q), 0)
     bias_blk = None
     if bias_ref is not None:
         bias_blk = bias_ref[0, pl.dslice(k_idx * block_k, block_k)]
@@ -237,47 +250,52 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
         do = do_ref[pl.dslice(qb * block_q, block_q), :]
         lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
         delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bk, bq]
         if bias_blk is not None:
-            s = s + bias_blk[None, :].astype(jnp.float32)
+            st = st + bias_blk.astype(jnp.float32)[:, None]
         mask = k_pos < kv_len
         q_pos = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
+            jnp.int32, (block_k, block_q), 1)
         mask = mask & (q_pos < q_len)
         if causal:
             mask = mask & (q_pos >= k_pos)
         lse_okf = jnp.isfinite(lse).astype(jnp.float32)
         lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
-        p = jnp.where(mask, jnp.exp(s - lse_safe[:, None]),
-                      0.0) * lse_okf[:, None]
+        p = jnp.where(mask, jnp.exp(st - lse_safe[None, :]),
+                      0.0) * lse_okf[None, :]
         dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [bk, bq]
         p_drop = p
         if dropout_rate > 0.0:
-            keep = _dropout_keep((block_q, block_k), dropout_rate,
+            keep = _dropout_keep((block_k, block_q), dropout_rate,
                                  seed_ref[0, 0], (bh_idx, qb, k_idx))
             inv = 1.0 / (1.0 - dropout_rate)
             p_drop = jnp.where(keep, p * inv, 0.0)
             dp = jnp.where(keep, dp * inv, 0.0)
-        ds = p * (dp - delta[:, None])
+        ds = p * (dp - delta[None, :])  # [bk, bq]
         # bf16 operands on the transposed contractions: the MXU runs f32
         # dots at a fraction of its bf16 rate
         dv = dv + jax.lax.dot_general(
             p_drop.astype(v.dtype), do.astype(v.dtype),
-            (((0,), (0,)), ((), ())),
+            (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)  # [bk, d]
         dk = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        db = db + jnp.sum(ds, axis=0)  # per-key bias cotangent
+        if db is not None:
+            db = db + jax.lax.dot_general(
+                ds, jnp.ones((1, block_q), jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bk, 1]
         return dk, dv, db
 
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
-    db0 = jnp.zeros((block_k,), jnp.float32)
+    db0 = (jnp.zeros((block_k, 1), jnp.float32)
+           if db_ref is not None else None)
     qb_lo = (k_idx * block_k) // block_q if causal else 0
     dk, dv, db = jax.lax.fori_loop(qb_lo, q_pad // block_q, body,
                                    (dk0, dv0, db0))
@@ -285,7 +303,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
     if db_ref is not None:
         db_ref[0, pl.dslice(k_idx * block_k, block_k)] = \
-            db.astype(db_ref.dtype)
+            db[:, 0].astype(db_ref.dtype)
 
 
 # ---------------------------------------------------------------------------

@@ -110,6 +110,88 @@ out["mask_reuse_err"] = float(np.max(np.abs(np.asarray(dv[0]) - pred)))
 dv2 = jax.grad(loss_v)(v_eye)
 out["bwd_determinism_err"] = float(jnp.max(jnp.abs(dv - dv2)))
 
+# --- 3. BLOCK-kernel path (T > 512): compiled fwd/bwd vs reference + in-
+# kernel dropout fwd/bwd agreement in the transposed [bk, bq] layout ----
+Tl = 1024
+ql = jnp.asarray(rng.randn(2, Tl, HD) * 0.3, jnp.bfloat16)
+kl = jnp.asarray(rng.randn(2, Tl, HD) * 0.3, jnp.bfloat16)
+vl = jnp.asarray(rng.randn(2, Tl, HD) * 0.3, jnp.bfloat16)
+gl = jnp.asarray(rng.randn(2, Tl, HD) * 0.1, jnp.bfloat16)
+
+def kern_loss_l(q, k, v):
+    o = fa.flash_attention(q, k, v, H, causal=True)
+    return jnp.sum(o.astype(jnp.float32) * gl.astype(jnp.float32))
+
+def ref_loss_l(q, k, v):
+    def split(x):
+        return x.reshape(2, Tl, H, D).transpose(0, 2, 1, 3)
+    o = fa.mha_reference(split(q), split(k), split(v), None, True)
+    o = o.transpose(0, 2, 1, 3).reshape(2, Tl, HD)
+    return jnp.sum(o.astype(jnp.float32) * gl.astype(jnp.float32))
+
+o1 = fa.flash_attention(ql, kl, vl, H, causal=True)
+def split_l(x):
+    return x.reshape(2, Tl, H, D).transpose(0, 2, 1, 3)
+o2 = fa.mha_reference(split_l(ql), split_l(kl), split_l(vl), None, True)
+o2 = o2.transpose(0, 2, 1, 3).reshape(2, Tl, HD)
+out["blk_fwd_err"] = float(jnp.max(jnp.abs(
+    o1.astype(jnp.float32) - o2.astype(jnp.float32))))
+g1 = jax.grad(kern_loss_l, argnums=(0, 1, 2))(ql, kl, vl)
+g2 = jax.grad(ref_loss_l, argnums=(0, 1, 2))(ql, kl, vl)
+out["blk_bwd_err"] = max(float(jnp.max(jnp.abs(
+    a.astype(jnp.float32) - b.astype(jnp.float32))))
+    for a, b in zip(g1, g2))
+
+# block-path dropout probe (T=1024 so the block kernels engage):
+# uniform attention (q=0) -> p = 1/T; with v = eye(T, 128) the fwd
+# output directly shows the keep mask for keys < 128, and dV with
+# g = ones shows per-key keep counts — fwd/bwd masks must agree.
+Tb = 1024
+q0b = jnp.zeros((1, Tb, 128), jnp.float32)  # H=1, D=128
+v_eyeb = jnp.eye(Tb, 128, dtype=jnp.float32)[None, :, :]
+scale_b = Tb * (1.0 - rate)
+ob = fa.flash_attention(q0b, q0b, v_eyeb, 1, causal=False,
+                        dropout_rate=rate, rng=key)
+mo = np.asarray(ob[0]) * scale_b  # mo[q, c] = keep(q, key=c), c < 128
+out["blk_dropout_binary"] = bool(np.all((np.abs(mo - 1) < 0.1)
+                                        | (np.abs(mo) < 0.1)))
+out["blk_dropout_keep_rate"] = float((mo > 0.5).mean())
+
+def loss_vb(vv):
+    o = fa.flash_attention(q0b, q0b, vv, 1, causal=False,
+                           dropout_rate=rate, rng=key)
+    return jnp.sum(o)
+
+dvb = jax.grad(loss_vb)(v_eyeb)   # dV[k, c] = sum_q p_drop[q, k]
+dvb2 = jax.grad(loss_vb)(v_eyeb)
+out["blk_bwd_determinism_err"] = float(jnp.max(jnp.abs(dvb - dvb2)))
+pred_b = (mo > 0.5).astype(np.float32).sum(axis=0) * 128.0 / scale_b
+got_b = np.asarray(dvb[0])[:128, :].sum(axis=1)  # cols all equal
+out["blk_mask_reuse_err"] = float(np.max(np.abs(got_b - pred_b)))
+
+# block-path BIAS case: compiled lowering of the transposed bias add +
+# the [bk,1] ones-column db dot / db[:,0] store (interpret mode never
+# dispatches to block kernels)
+bias_l = jnp.asarray(np.where(rng.rand(2, Tl) > 0.2, 0.0, -1e9),
+                     jnp.float32)
+kbl = bias_l[:, None, None, :]
+
+def kern_loss_lb(q, k, v, b):
+    o = fa.flash_attention(q, k, v, H, bias=b, causal=False)
+    return jnp.sum(o.astype(jnp.float32) * gl.astype(jnp.float32))
+
+def ref_loss_lb(q, k, v, b):
+    o = fa.mha_reference(split_l(q), split_l(k), split_l(v),
+                         b[:, None, None, :], False)
+    o = o.transpose(0, 2, 1, 3).reshape(2, Tl, HD)
+    return jnp.sum(o.astype(jnp.float32) * gl.astype(jnp.float32))
+
+g1b = jax.grad(kern_loss_lb, argnums=(0, 1, 2, 3))(ql, kl, vl, bias_l)
+g2b = jax.grad(ref_loss_lb, argnums=(0, 1, 2, 3))(ql, kl, vl, bias_l)
+out["blk_bias_bwd_err"] = max(float(jnp.max(jnp.abs(
+    a.astype(jnp.float32) - b.astype(jnp.float32))))
+    for a, b in zip(g1b, g2b))
+
 print(json.dumps(out))
 """
 
@@ -164,3 +246,22 @@ def test_dropout_mask_fwd_bwd_agreement():
     # backward regenerates the identical keep mask
     assert r["mask_reuse_err"] < 1e-2, r
     assert r["bwd_determinism_err"] == 0.0, r
+
+
+def test_block_kernel_path():
+    """T=1024 engages the BLOCK kernels (transposed [bk, bq] scores):
+    compiled fwd/bwd vs reference, in-kernel dropout mask binary +
+    fwd/bwd agreement via the dV keep-count prediction."""
+    r = _result()
+    assert r["blk_fwd_err"] < 3e-2, r
+    assert r["blk_bwd_err"] < 6e-2, r
+    assert r["blk_dropout_binary"], r
+    sigma = (0.3 * 0.7 / (1024 * 128)) ** 0.5
+    assert abs(r["blk_dropout_keep_rate"] - 0.7) < 5 * sigma, r
+    assert r["blk_bwd_determinism_err"] == 0.0, r
+    # got/pred magnitudes are ~128 (keep-count sums); a SINGLE flipped
+    # mask bit between fwd and bwd shifts a value by 128/716.8 = 0.179,
+    # while f32 accumulation rounding lands ~0.1 — the threshold sits
+    # between (observed 0.104 = 8e-4 relative)
+    assert r["blk_mask_reuse_err"] < 0.15, r
+    assert r["blk_bias_bwd_err"] < 6e-2, r
