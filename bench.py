@@ -45,10 +45,15 @@ def _build(model, on_tpu):
     from paddle_tpu import models
 
     if model == "transformer":
-        seq_len = 256 if on_tpu else 64
+        # BENCH_SEQ overrides for long-context runs (T > 512 engages the
+        # block flash kernels); on TPU the batch auto-scales to keep
+        # tokens/step constant, off-TPU smoke runs keep batch=4
+        seq_len = int(os.environ.get("BENCH_SEQ", 256 if on_tpu else 64))
+        if seq_len <= 0:
+            raise SystemExit("BENCH_SEQ must be a positive integer")
         spec = models.transformer.transformer_base(
             seq_len=seq_len, dropout_rate=0.1)
-        batch = 128 if on_tpu else 4
+        batch = max(1, (128 * 256) // seq_len) if on_tpu else 4
         return (spec, batch, "transformer_base_tokens_per_sec_per_chip",
                 "tokens/sec", spec.tokens_per_example)
     if model == "bert":
