@@ -1,13 +1,20 @@
-"""Benchmark: training throughput on one chip for the BASELINE configs.
+"""Benchmark: training throughput on one chip for ALL BASELINE configs.
 
-Default (driver-run): Transformer-base NMT (BASELINE config 3). Select
-others with ``--model resnet50|bert|transformer`` or ``BENCH_MODEL``.
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-``vs_baseline`` is model FLOPs utilization (MFU) relative to the
-BASELINE.json north-star target of 45% MFU (>1.0 beats the target).
-Measurement follows the reference convention of examples/sec
-(``benchmark/fluid/fluid_benchmark.py:297``), expressed per-token for the
-sequence models.
+Default (driver-run): every BASELINE config, one JSON line each —
+deepfm, long-context (seq-2048), resnet50, bert-dygraph, bert, and
+transformer-base last (the flagship). Select a single config with
+``--model`` / ``BENCH_MODEL`` (``transformer|bert|resnet50|deepfm|
+seq2048|all``; ``--dygraph`` routes bert through the dygraph build).
+
+Each line: {"metric", "value", "unit", "vs_baseline"}. ``vs_baseline``
+is model FLOPs utilization (MFU) relative to the BASELINE.json
+north-star target of 45% MFU (>1.0 beats the target); for the
+bandwidth-bound DeepFM config it is throughput vs 45% of the
+roofline-implied examples/sec (max of compute and HBM-traffic floors —
+MFU is meaningless for a gather-dominated model). Measurement follows
+the reference convention of examples/sec per model
+(``benchmark/fluid/fluid_benchmark.py:297``), expressed per-token for
+the sequence models.
 """
 
 import argparse
@@ -40,22 +47,43 @@ def _peak_flops(device):
     return 197e12  # assume v5e-class if unrecognized
 
 
-def _build(model, on_tpu):
-    """Returns (spec_builder_result, batch, metric_name, unit, per_example)."""
+def _peak_hbm_gbs(device):
+    """Measured-class HBM stream bandwidth (CHIP_CEILING.json: 552 GB/s
+    on the benched v5e; 819 nominal). Used only for the DeepFM roofline."""
+    if device.platform == "cpu":
+        return 10e9
+    return 552e9
+
+
+def _build(model, on_tpu, seq_override=None):
+    """Returns (spec, batch, metric_name, unit, per_example)."""
     from paddle_tpu import models
 
     if model == "transformer":
         # BENCH_SEQ overrides for long-context runs (T > 512 engages the
         # block flash kernels); on TPU the batch auto-scales to keep
-        # tokens/step constant, off-TPU smoke runs keep batch=4
-        seq_len = int(os.environ.get("BENCH_SEQ", 256 if on_tpu else 64))
-        if seq_len <= 0:
-            raise SystemExit("BENCH_SEQ must be a positive integer")
+        # tokens/step constant (rounding batch down — tokens/step drops
+        # below 32768 for seq_len values that don't divide it), off-TPU
+        # smoke runs keep batch=4
+        seq_env = os.environ.get("BENCH_SEQ", "")
+        if seq_override is not None:
+            seq_len = seq_override
+        elif seq_env:
+            try:
+                seq_len = int(seq_env)
+            except ValueError:
+                raise SystemExit("BENCH_SEQ must be a positive integer")
+            if seq_len <= 0:
+                raise SystemExit("BENCH_SEQ must be a positive integer")
+        else:
+            seq_len = 256 if on_tpu else 64
+        name = ("transformer_base_tokens_per_sec_per_chip"
+                if seq_len <= 512 and seq_override is None else
+                "transformer_base_seq%d_tokens_per_sec_per_chip" % seq_len)
         spec = models.transformer.transformer_base(
             seq_len=seq_len, dropout_rate=0.1)
         batch = max(1, (128 * 256) // seq_len) if on_tpu else 4
-        return (spec, batch, "transformer_base_tokens_per_sec_per_chip",
-                "tokens/sec", spec.tokens_per_example)
+        return spec, batch, name, "tokens/sec", spec.tokens_per_example
     if model == "bert":
         seq_len = 128 if on_tpu else 32
         spec = models.bert.bert_base(seq_len=seq_len) if on_tpu else \
@@ -68,17 +96,80 @@ def _build(model, on_tpu):
         spec = models.resnet.resnet_imagenet(depth=50) if on_tpu else \
             models.resnet.resnet_imagenet(depth=50, class_num=10,
                                           image_shape=(3, 64, 64))
-        batch = 128 if on_tpu else 2
+        batch = int(os.environ.get("BENCH_RESNET_BATCH", 128)) \
+            if on_tpu else 2
         return (spec, batch, "resnet50_images_per_sec_per_chip",
                 "images/sec", 1)
+    if model == "deepfm":
+        spec = models.deepfm.deepfm() if on_tpu else \
+            models.deepfm.deepfm(sparse_feature_dim=1000,
+                                 hidden_sizes=(64, 64))
+        batch = 32768 if on_tpu else 16
+        return (spec, batch, "deepfm_examples_per_sec_per_chip",
+                "examples/sec", 1)
     raise SystemExit("unknown model %r" % model)
+
+
+def _bench_static(model, on_tpu, seq_override=None):
+    """One static-graph config; returns the bench record dict."""
+    import jax
+    import paddle_tpu as fluid
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main_prog, startup):
+        spec, batch, metric, unit, per_example = _build(
+            model, on_tpu, seq_override)
+        opt = fluid.optimizer.Adam(learning_rate=1e-4)
+        if os.environ.get("BENCH_AMP", "1") == "1":
+            opt = fluid.amp.decorate(opt)  # bf16 MXU compute
+        opt.minimize(spec.loss)
+
+    batch = int(os.environ.get("BENCH_BATCH", batch))
+    steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
+
+    exe = fluid.Executor(fluid.XLAPlace(0))
+    scope = fluid.Scope()
+    with fluid.scope_guard(scope):
+        exe.run(startup)
+        feed = spec.sample_batch(batch, np.random.RandomState(0))
+        # stage the batch on device once (the py_reader prefetch path does
+        # this continuously during real training; the timed loop must not
+        # re-ship the same batch over the host link every step)
+        feed = {k: jax.device_put(v) for k, v in feed.items()}
+        # warmup: compile + 2 steps
+        for _ in range(2):
+            loss_val, = exe.run(main_prog, feed=feed,
+                                fetch_list=[spec.loss])
+        np.asarray(loss_val)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss_val, = exe.run(main_prog, feed=feed,
+                                fetch_list=[spec.loss],
+                                return_numpy=False)
+        np.asarray(loss_val)  # sync
+        dt = time.perf_counter() - t0
+
+    examples_per_sec = batch * per_example * steps / dt
+    dev = jax.devices()[0]
+    if model == "deepfm":
+        # roofline basis: per-example floor = max(compute, HBM traffic)
+        floor_s = max((spec.flops_per_example or 0) / _peak_flops(dev),
+                      (getattr(spec, "bytes_per_example", 0) or 0)
+                      / _peak_hbm_gbs(dev))
+        target = 0.45 / max(floor_s, 1e-30)   # 45% of roofline examples/s
+        vsb = (examples_per_sec / per_example) / target
+    else:
+        flops_per_step = (spec.flops_per_example or 0) * batch
+        mfu = (flops_per_step * steps / dt) / _peak_flops(dev)
+        vsb = mfu / 0.45
+    return {"metric": metric, "value": round(examples_per_sec, 1),
+            "unit": unit, "vs_baseline": round(vsb, 4)}
 
 
 def _bench_bert_dygraph(on_tpu):
     """BASELINE config 4 as written: BERT through the DYGRAPH build,
     functional export -> one jitted train step (models/bert_dygraph.py)."""
     import jax
-    import numpy as np
     from paddle_tpu.models import bert_dygraph
 
     amp = os.environ.get("BENCH_AMP", "1") == "1"
@@ -114,75 +205,54 @@ def _bench_bert_dygraph(on_tpu):
     tokens_per_sec = batch * toks * steps / dt
     mfu = (flops_per_example * batch * steps / dt) / _peak_flops(
         jax.devices()[0])
-    print(json.dumps({
+    return {
         "metric": "bert_base_dygraph_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.45, 4),
-    }))
+    }
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--model", default=os.environ.get("BENCH_MODEL",
-                                                      "transformer"),
-                    choices=["transformer", "bert", "resnet50"])
+    ap.add_argument("--model", default=os.environ.get("BENCH_MODEL", "all"),
+                    choices=["all", "transformer", "bert", "resnet50",
+                             "deepfm", "seq2048"])
     ap.add_argument("--dygraph", action="store_true",
                     default=os.environ.get("BENCH_DYGRAPH", "") == "1",
                     help="route bert through the dygraph build")
     args = ap.parse_args()
 
     import jax
-    import paddle_tpu as fluid
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # JAX_PLATFORMS=cpu alone does NOT beat the axon plugin — the
+        # config update is required (same dance as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
 
     on_tpu = jax.devices()[0].platform == "tpu"
 
+    def emit(rec):
+        print(json.dumps(rec), flush=True)
+
+    if args.model == "all":
+        # full BASELINE matrix; transformer (the flagship) prints LAST so
+        # single-line consumers of the output still see the headline row
+        emit(_bench_static("deepfm", on_tpu))
+        emit(_bench_static("transformer", on_tpu,
+                           seq_override=2048 if on_tpu else 128))
+        emit(_bench_static("resnet50", on_tpu))
+        emit(_bench_bert_dygraph(on_tpu))
+        emit(_bench_static("bert", on_tpu))
+        emit(_bench_static("transformer", on_tpu))
+        return
+
+    if args.model == "seq2048":
+        return emit(_bench_static("transformer", on_tpu,
+                                  seq_override=2048 if on_tpu else 128))
     if args.model == "bert" and args.dygraph:
-        return _bench_bert_dygraph(on_tpu)
-
-    main_prog, startup = fluid.Program(), fluid.Program()
-    with fluid.program_guard(main_prog, startup):
-        spec, batch, metric, unit, per_example = _build(args.model, on_tpu)
-        opt = fluid.optimizer.Adam(learning_rate=1e-4)
-        if os.environ.get("BENCH_AMP", "1") == "1":
-            opt = fluid.amp.decorate(opt)  # bf16 MXU compute
-        opt.minimize(spec.loss)
-
-    batch = int(os.environ.get("BENCH_BATCH", batch))
-    steps = int(os.environ.get("BENCH_STEPS", 30 if on_tpu else 3))
-
-    exe = fluid.Executor(fluid.XLAPlace(0))
-    scope = fluid.Scope()
-    with fluid.scope_guard(scope):
-        exe.run(startup)
-        feed = spec.sample_batch(batch, np.random.RandomState(0))
-        # stage the batch on device once (the py_reader prefetch path does
-        # this continuously during real training; the timed loop must not
-        # re-ship the same batch over the host link every step)
-        feed = {k: jax.device_put(v) for k, v in feed.items()}
-        # warmup: compile + 2 steps
-        for _ in range(2):
-            loss_val, = exe.run(main_prog, feed=feed,
-                                fetch_list=[spec.loss])
-        np.asarray(loss_val)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss_val, = exe.run(main_prog, feed=feed,
-                                fetch_list=[spec.loss],
-                                return_numpy=False)
-        np.asarray(loss_val)  # sync
-        dt = time.perf_counter() - t0
-
-    examples_per_sec = batch * per_example * steps / dt
-    flops_per_step = (spec.flops_per_example or 0) * batch
-    mfu = (flops_per_step * steps / dt) / _peak_flops(jax.devices()[0])
-    out = {
-        "metric": metric,
-        "value": round(examples_per_sec, 1),
-        "unit": unit,
-        "vs_baseline": round(mfu / 0.45, 4),
-    }
-    print(json.dumps(out))
+        return emit(_bench_bert_dygraph(on_tpu))
+    emit(_bench_static(args.model, on_tpu))
 
 
 if __name__ == "__main__":

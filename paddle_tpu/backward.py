@@ -82,6 +82,19 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
     return list(zip(params, grad_vars))
 
 
+def require_merged_sparse(program):
+    """Ask the program's autodiff op(s) to emit sparse (rows, values)
+    grads with duplicates merged (each row once, zero-filled duplicate
+    slots on an out-of-range sentinel). Called by consumers whose math
+    needs once-per-row semantics: norm-based clipping and sparse weight
+    decay. Everything else (scatter-add accumulation, the non-lazy
+    densify, lazy optimizers' internal re-merge) is duplicate-safe, and
+    the merge is expensive (argsort + segment-sum per table per step)."""
+    for op in getattr(program, "_backward_ops", ()):
+        if op.type == "autodiff":
+            op.attrs["merge_sparse"] = True
+
+
 def calc_gradient(targets, inputs, target_gradients=None, no_grad_set=None):
     """Gradients of ``targets`` w.r.t arbitrary ``inputs`` (ref
     ``backward.py:613``). ``target_gradients`` supplies the cotangent

@@ -126,12 +126,16 @@ def append_gradient_clip_ops(params_grads):
     for attr, p, g in default:
         by_attr.setdefault(id(attr), (attr, []))[1].append((p, g))
     # Sparse (rows, values) grads flow through the same clip ops: the
-    # autodiff emits them row-merged with zeros in duplicate slots, so a
-    # squared_l2_norm over the values equals the dense-grad norm, and an
-    # elementwise scale of the values scales the logical dense grad (ref
-    # clip.py merges SelectedRows before clipping for the same reason).
+    # autodiff emits them row-merged with zeros in duplicate slots (we
+    # request that below), so a squared_l2_norm over the values equals the
+    # dense-grad norm, and an elementwise scale of the values scales the
+    # logical dense grad (ref clip.py merges SelectedRows before clipping
+    # for the same reason).
     sparse_rows = {p.name: g.sparse_rows_var for _, p, g in default
                    if getattr(g, "sparse_rows_var", None) is not None}
+    if sparse_rows:
+        from .backward import require_merged_sparse
+        require_merged_sparse(default[0][1].block.program)
     for attr, group in by_attr.values():
         processed = attr._process(group)
         for p, g in processed:

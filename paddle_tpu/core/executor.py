@@ -494,6 +494,11 @@ class Executor:
         def to_spec(var):
             spec = getattr(var, "sharding", None)
             if spec is None:
+                like = getattr(var, "sharding_like", None)
+                if (like is not None
+                        and tuple(var.shape or ()) == tuple(like.shape or ())):
+                    spec = getattr(like, "sharding", None)
+            if spec is None:
                 return P()
             # axes absent from this mesh degrade to replication, so an
             # mp-annotated program runs unchanged on a dp-only mesh
@@ -505,7 +510,9 @@ class Executor:
         for v in program.list_vars():
             if not v.persistable:
                 continue
-            if getattr(v, "sharding", None) is not None:
+            if (getattr(v, "sharding", None) is not None
+                    or getattr(getattr(v, "sharding_like", None),
+                               "sharding", None) is not None):
                 param_shardings[v.name] = NamedSharding(mesh, to_spec(v))
             elif (zero_state and dp_size is not None
                   and getattr(v, "is_optimizer_state", False)

@@ -177,9 +177,16 @@ def _autodiff(env, op):
                 val_parts.append(vals)
             rows = jnp.concatenate(rows_parts, axis=0)
             g = jnp.concatenate(val_parts, axis=0)
-            # merge duplicates once here so downstream clip/decay ops see
-            # each row exactly once (zeros elsewhere) and norms are exact
-            rows, g = merge_sparse_rows(rows, g, vocab)
+            if op.attr("merge_sparse", False):
+                # duplicate slots are merged at the source ONLY when a
+                # downstream consumer needs each row exactly once — norm
+                # clips and sparse_decay (they set this attr via
+                # backward.require_merged_sparse). Plain optimizer paths
+                # either scatter-ADD (duplicates accumulate correctly) or
+                # re-merge internally (lazy adam/momentum), and the
+                # argsort+segment merge costs ~7 ms/step on the DeepFM
+                # bench, so it must not run unconditionally.
+                rows, g = merge_sparse_rows(rows, g, vocab)
             if callback is not None:
                 g = callback(name, g)
             put(env, v, g)

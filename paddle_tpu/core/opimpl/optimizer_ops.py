@@ -141,9 +141,18 @@ def _adam(env, op):
     # accumulators arrive already holding beta^t for the current step t
     # (initialized to beta at t=1), so use them directly.
     lr_t = lr * jnp.sqrt(1 - b2p) / (1 - b1p)
+    if rows is not None and not op.attr("lazy_mode", False):
+        # ref adam_op.h default (lazy_mode=false): sparse grad is merged
+        # and the update runs over EVERY row — identical math to the dense
+        # branch on the densified grad. On TPU this is also the fast path:
+        # one scatter-add (~15 ns/row) replaces the lazy branch's 3 row
+        # gathers + 3 row scatters (measured 45 -> ~12 ms/step on the
+        # DeepFM bench, tools/bench_gather.py has the per-op rates).
+        g = _densify(g.astype(p.dtype), rows, p.shape)
+        rows = None
     if rows is not None:
-        # ref adam_op.h SparseAdamFunctor (lazy mode): only touched rows'
-        # moments advance; pow accumulators still advance every step
+        # ref adam_op.h SparseAdamFunctor (lazy_mode=true): only touched
+        # rows' moments advance; pow accumulators still advance every step
         rows_u, g_u = _merge_rows(rows, g, p.shape[0])
         m_rows = b1 * m[rows_u] + (1 - b1) * g_u
         v_rows = b2 * v[rows_u] + (1 - b2) * jnp.square(g_u)

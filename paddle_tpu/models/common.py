@@ -34,15 +34,21 @@ class ModelSpec:
       fetches: extra fetch Variables by name (e.g. accuracy).
       flops_per_example: analytic fwd+bwd FLOPs per example (for MFU calc);
         None if not computed.
+      bytes_per_example: analytic HBM traffic per example for
+        bandwidth-bound models (embedding gather/scatter + sparse-opt
+        row touches); None if not computed. Basis for roofline-style
+        vs_baseline where MFU is meaningless (bench.py deepfm).
       tokens_per_example: for sequence models, tokens per example.
     """
 
     def __init__(self, loss, feeds, fetches=None, flops_per_example=None,
-                 tokens_per_example=None, extras=None):
+                 tokens_per_example=None, extras=None,
+                 bytes_per_example=None):
         self.loss = loss
         self.feeds = feeds
         self.fetches = dict(fetches or {})
         self.flops_per_example = flops_per_example
+        self.bytes_per_example = bytes_per_example
         self.tokens_per_example = tokens_per_example
         # named internal vars (e.g. pipeline cut points, block outputs)
         self.extras = dict(extras or {})

@@ -49,10 +49,27 @@ def deepfm(sparse_feature_dim=100000, num_fields=26, embedding_size=16,
     loss = layers.mean(
         layers.sigmoid_cross_entropy_with_logits(logits, label_f))
     prob = layers.ops.sigmoid(logits)
+
+    # analytic per-example cost for the bench roofline (bench.py):
+    # compute — the deep MLP dominates FLOPs (fwd+bwd ~= 6 * sum(in*out));
+    # traffic — the model is embedding-row-bound, and on TPU a narrow-row
+    # access moves one PHYSICAL 128-lane (512 B) tile row regardless of K
+    # (the packed layout in ops/rowops.py makes the fwd gather ride that
+    # burst at measured ~213 GB/s; the bwd scatter-add reads+writes it —
+    # tools/bench_gather.py has the measured rates). Per example: F rows
+    # from each of 2 tables (w1 + fm_emb), x1 burst for the gather and x2
+    # for the scatter read-modify-write. The dense-Adam full-table pass is
+    # batch-amortized and excluded (<2% at the bench batch).
+    dims = [num_fields * embedding_size + dense_dim] + list(hidden_sizes) \
+        + [1]
+    mlp_flops = 6 * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
+    emb_bytes = 2 * num_fields * 512 * (1 + 2)
     return ModelSpec(
         loss,
         feeds={"feat_ids": FeedSpec([num_fields], "int64", 0,
                                     sparse_feature_dim),
                "dense_value": FeedSpec([dense_dim], "float32", 0.0, 1.0),
                "label": FeedSpec([1], "int64", 0, 2)},
-        fetches={"prob": prob})
+        fetches={"prob": prob},
+        flops_per_example=mlp_flops,
+        bytes_per_example=emb_bytes)

@@ -276,11 +276,14 @@ def _bf16_ce_fwd(x2, w, b, y2, eps):
     if b is not None:
         logits = logits + b.astype(jnp.bfloat16)
     loss, m, s = _bf16_stats(logits, y2, eps)
-    return loss, (xb, wb, logits, m, s, y2)
+    # zero-size dtype carriers: cotangents must match the PRIMAL dtypes
+    # (x2 may be f32 while xb is bf16; b may be None)
+    protos = (x2[:0], None if b is None else b[:0])
+    return loss, (xb, wb, logits, m, s, y2, protos)
 
 
 def _bf16_ce_bwd(eps, res, g):
-    xb, wb, logits, m, s, y2 = res
+    xb, wb, logits, m, s, y2, (x_proto, b_proto) = res
     t, v = logits.shape
     p = jnp.exp(logits.astype(jnp.float32) - m[:, None]) / s[:, None]
     onehot = (jax.lax.broadcasted_iota(jnp.int32, (t, v), 1)
@@ -291,9 +294,11 @@ def _bf16_ce_bwd(eps, res, g):
     dl = (dz * g[:, None].astype(jnp.float32)).astype(jnp.bfloat16)
     # bf16 OPERANDS (the traffic win) with f32-stored dot outputs: the MXU
     # accumulates f32 regardless, storing bf16 would just re-round grads
-    dx = jnp.dot(dl, wb.T, preferred_element_type=jnp.float32)
+    dx = jnp.dot(dl, wb.T,
+                 preferred_element_type=jnp.float32).astype(x_proto.dtype)
     dw = jnp.dot(xb.T, dl, preferred_element_type=jnp.float32)
-    db = jnp.sum(dl.astype(jnp.float32), axis=0)
+    db = (None if b_proto is None
+          else jnp.sum(dl.astype(jnp.float32), axis=0).astype(b_proto.dtype))
     return dx, dw, db, None
 
 

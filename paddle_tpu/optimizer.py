@@ -77,9 +77,13 @@ class Optimizer:
             name=var_name, shape=shape, dtype=dtype, persistable=True)
         # accumulators lay out like their parameter on the mesh (the
         # reference keeps optimizer state on the param's device/pserver
-        # shard; here: same PartitionSpec, so sharded optimizers stay local)
+        # shard; here: same PartitionSpec, so sharded optimizers stay
+        # local). The annotation may arrive AFTER minimize() — e.g.
+        # DistributeTranspiler sharding is_distributed tables — so keep a
+        # live link for the executor to resolve at compile time.
         if tuple(shape) == tuple(param.shape):
             var.sharding = getattr(param, "sharding", None)
+            var.sharding_like = param
         # marks the var as optimizer state for BuildStrategy.Reduce
         # (ZeRO-style dp-sharding of accumulators, executor._mesh_shardings)
         var.is_optimizer_state = True
@@ -357,6 +361,7 @@ class Adam(Optimizer):
                  lazy_mode=False):
         super().__init__(learning_rate, regularization, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lazy_mode = lazy_mode
 
     def _append_optimize_op(self, block, param_and_grad):
         p, g = param_and_grad
@@ -374,7 +379,7 @@ class Adam(Optimizer):
             {"ParamOut": p, "Moment1Out": m1, "Moment2Out": m2,
              "Beta1PowOut": b1p, "Beta2PowOut": b2p},
             {"beta1": self._beta1, "beta2": self._beta2,
-             "epsilon": self._epsilon})
+             "epsilon": self._epsilon, "lazy_mode": self._lazy_mode})
 
 
 class Adamax(Optimizer):

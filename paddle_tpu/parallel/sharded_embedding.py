@@ -28,12 +28,16 @@ def sharded_lookup(table, ids, mesh, axis="mp"):
     rows_per = v // n_shards
 
     def local_lookup(tab, ids_):
+        from ..ops.rowops import packed_take
+
         idx = jax.lax.axis_index(axis)
         lo = idx * rows_per
         local = ids_ - lo
         mask = (local >= 0) & (local < rows_per)
         safe = jnp.clip(local, 0, rows_per - 1)
-        rows = jnp.take(tab, safe, axis=0)
+        # shard-local table is unsharded inside shard_map: the packed
+        # narrow-row gather applies (ops/rowops.py, 4x the plain rate)
+        rows = packed_take(tab, safe)
         rows = rows * mask[..., None].astype(rows.dtype)
         return jax.lax.psum(rows, axis)
 
@@ -53,6 +57,7 @@ def _sharded_lookup_op(env, op):
     ids = get(env, op.input("Ids")).astype(jnp.int32)
     if ids.ndim >= 2 and ids.shape[-1] == 1:
         ids = ids.squeeze(-1)
+    padding_idx = op.attr("padding_idx", -1)
     from .mesh import get_mesh
 
     mesh = get_mesh()
@@ -60,5 +65,14 @@ def _sharded_lookup_op(env, op):
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
         out = sharded_lookup(w, ids, mesh, axis)
     else:
-        out = jnp.take(w, ids, axis=0)
-    put(env, op.output("Out"), out)
+        from ..ops.rowops import packed_take
+
+        out = packed_take(w, ids) if w.ndim == 2 else jnp.take(w, ids,
+                                                               axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        # same contract as lookup_table: padding rows read as zeros (the
+        # autodiff sparse sites already zero their gradient slots)
+        mask = (ids != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    from ..core.op_registry import amp_out_cast
+    put(env, op.output("Out"), amp_out_cast(out))

@@ -59,9 +59,22 @@ class DistributeTranspiler:
             axis = "mp" if "mp" in mesh.axis_names else (
                 "ep" if "ep" in mesh.axis_names else None)
             if axis:
+                sharded = set()
                 for p in program.all_parameters():
                     if getattr(p, "is_distributed", False) and len(p.shape) == 2:
                         p.sharding = (axis, None)  # row-sharded table
+                        sharded.add(p.name)
+                # route lookups through the explicit shard_map op
+                # (psum-of-partials, sharded_embedding.py): GSPMD's gather
+                # partitioning may otherwise all-gather the full table —
+                # the exact collective the pserver replacement must avoid
+                # (ref parameter_prefetch.cc pulls only needed rows).
+                for op in program.global_block().ops:
+                    if (op.type == "lookup_table"
+                            and op.input("W") is not None
+                            and op.input("W").name in sharded):
+                        op.type = "sharded_lookup_table"
+                        op.attrs["mesh_axis"] = axis
         if not sync_mode:
             # async SGD has no XLA analog; document sync-equivalent behavior
             # (ref SURVEY.md §7 hard parts) — convergence parity, not step

@@ -15,7 +15,11 @@ class WeightDecayRegularizer:
 
 def _append_sparse_decay(param, grad, block, coeff, mode):
     """Row-wise decay on the touched rows of a sparse (rows, values) grad —
-    ref regularizer.py SelectedRows branch (merge + decay on rows)."""
+    ref regularizer.py SelectedRows branch (merge + decay on rows).
+    Decay-per-row must apply exactly once, so the autodiff is asked to
+    emit merged rows (duplicate slots zeroed on the sentinel)."""
+    from .backward import require_merged_sparse
+    require_merged_sparse(block.program)
     block.append_op(
         "sparse_decay",
         {"Grad": grad, "Rows": grad.sparse_rows_var, "Param": param},

@@ -1,0 +1,73 @@
+"""Isolate where DeepFM's step time goes: fwd / fwd+bwd / full opt step.
+
+Run on TPU: python tools/debug_deepfm.py [batch]
+"""
+import sys
+import time
+
+import numpy as np
+import jax
+
+sys.path.insert(0, ".")
+import paddle_tpu as fluid  # noqa: E402
+from paddle_tpu import models  # noqa: E402
+
+
+def timeit(run, steps=20):
+    run()
+    run()
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = run()
+    np.asarray(out)
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
+    variants = {
+        "fwd_only": False,
+        "train_sparse": "sparse",
+        "train_dense": "dense",
+    }
+    for name, mode in variants.items():
+        main_prog, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main_prog, startup):
+            if mode == "dense":
+                import paddle_tpu.layers as layers
+                from paddle_tpu.models import deepfm as dfm_mod
+                orig = layers.embedding
+
+                def emb_dense(*a, **kw):
+                    kw["is_sparse"] = False
+                    kw["is_distributed"] = False
+                    return orig(*a, **kw)
+                layers.embedding = emb_dense
+                try:
+                    spec = models.deepfm.deepfm()
+                finally:
+                    layers.embedding = orig
+            else:
+                spec = models.deepfm.deepfm()
+            if mode:
+                opt = fluid.optimizer.Adam(learning_rate=1e-4)
+                opt.minimize(spec.loss)
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        scope = fluid.Scope()
+        with fluid.scope_guard(scope):
+            exe.run(startup)
+            feed = spec.sample_batch(batch, np.random.RandomState(0))
+            feed = {k: jax.device_put(v) for k, v in feed.items()}
+
+            def run():
+                loss_val, = exe.run(main_prog, feed=feed,
+                                    fetch_list=[spec.loss],
+                                    return_numpy=False)
+                return loss_val
+            dt = timeit(run)
+            print("%-14s batch=%d  %8.3f ms/step  %.0f ex/s"
+                  % (name, batch, dt * 1e3, batch / dt))
+
+
+if __name__ == "__main__":
+    main()

@@ -132,7 +132,8 @@ def _categorize(name):
         return "copies"
     if "slice-start" in n or "slice-done" in n or "async" in n:
         return "async-slices"
-    if "convolution" in n or n.startswith("%dot") or "dot_general" in n:
+    if ("convolution" in n or n.lstrip("%").startswith("dot")
+            or "dot_general" in n):
         return "matmul"
     if "rng" in n or "bitcast-convert" in n and "threefry" in n:
         return "rng"
