@@ -24,6 +24,7 @@ PRNG primitives are TPU-only).
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -92,6 +93,34 @@ def _dropout_keep(shape, rate, seed, tags):
     return u < thresh
 
 
+def _kv_mask_lo(num_kb, q_idx, block_q, block_k, kv_len, kv_pad, causal):
+    """First k-block index needing a mask, for the fwd/dQ loop split:
+    interior blocks run the lean body; only diagonal blocks (causal) and
+    the padded kv tail are masked. Shared by _fwd_kernel and
+    _bwd_dq_kernel so their split arithmetic cannot drift apart."""
+    mask_lo = num_kb
+    if causal:
+        mask_lo = (q_idx * block_q) // block_k
+    if kv_len < kv_pad:
+        mask_lo = jnp.minimum(mask_lo, kv_len // block_k)
+    return mask_lo
+
+
+def _kv_mask(kb, q_idx, block_q, block_k, kv_len, kv_pad, causal):
+    """[block_k, block_q] keep-mask for a masked k-block iteration —
+    the kv-tail bound and/or the causal triangle (None if neither
+    applies)."""
+    k_pos = kb * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_k, block_q), 0)
+    mask = k_pos < kv_len if kv_len < kv_pad else None
+    if causal:
+        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        keep = q_pos >= k_pos
+        mask = keep if mask is None else mask & keep
+    return mask
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
                 block_k, causal, scale, kv_len, dropout_rate):
     from jax.experimental import pallas as pl
@@ -119,40 +148,54 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref, lse_ref, *,
         num_kb = jnp.minimum(
             num_kb, ((q_idx + 1) * q.shape[0] + block_k - 1) // block_k)
 
-    def body(kb, carry):
-        m_i, l_i, acc = carry
-        k = k_ref[pl.dslice(kb * block_k, block_k), :]
-        v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        st = jax.lax.dot_general(
-            k, q, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bk, bq]
-        if bias_ref is not None:
-            b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
-            st = st + b.astype(jnp.float32)[:, None]
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 0)
-        mask = k_pos < kv_len
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_k, block_q), 1)
-            mask = mask & (q_pos >= k_pos)
-        st = jnp.where(mask, st, -jnp.inf)
-        m_new = jnp.maximum(m_i, jnp.max(st, axis=0, keepdims=True))
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.where(mask, jnp.exp(st - m_safe), 0.0)
-        alpha = jnp.where(jnp.isfinite(m_i), jnp.exp(m_i - m_safe), 0.0)
-        l_new = alpha * l_i + jnp.sum(p, axis=0, keepdims=True)
-        p_use = p
-        if dropout_rate > 0.0:
-            keep = _dropout_keep((block_k, block_q), dropout_rate,
-                                 seed_ref[0, 0], (bh_idx, q_idx, kb))
-            p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-        acc_new = acc * alpha + jax.lax.dot_general(
-            v, p_use.astype(v.dtype), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [d, bq]
-        return m_new, l_new, acc_new
+    # mask specialization: interior blocks need NO mask at all — only the
+    # diagonal block (causal) and the padded kv tail do. The two [bk, bq]
+    # iotas + compares + selects per iteration are pure VPU overhead, so
+    # the loop is split into an unmasked prefix and a masked remainder.
+    kv_partial = kv_len < kv_pad          # static
+    mask_lo = _kv_mask_lo(num_kb, q_idx, block_q, block_k, kv_len,
+                          kv_pad, causal)
 
-    m_i, l_i, acc = jax.lax.fori_loop(0, num_kb, body, (m_i, l_i, acc))
+    def make_body(masked):
+        def body(kb, carry):
+            m_i, l_i, acc = carry
+            k = k_ref[pl.dslice(kb * block_k, block_k), :]
+            v = v_ref[pl.dslice(kb * block_k, block_k), :]
+            st = jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bk, bq]
+            if bias_ref is not None:
+                b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
+                st = st + b.astype(jnp.float32)[:, None]
+            if masked:
+                mask = _kv_mask(kb, q_idx, block_q, block_k, kv_len,
+                                kv_pad, causal)
+                st = jnp.where(mask, st, -jnp.inf)
+            m_new = jnp.maximum(m_i, jnp.max(st, axis=0, keepdims=True))
+            m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+            # exp(-inf - m_safe) is exactly 0 (m_safe finite), so masked
+            # slots vanish without a second select
+            p = jnp.exp(st - m_safe)
+            alpha = jnp.where(jnp.isfinite(m_i), jnp.exp(m_i - m_safe), 0.0)
+            l_new = alpha * l_i + jnp.sum(p, axis=0, keepdims=True)
+            p_use = p
+            if dropout_rate > 0.0:
+                keep = _dropout_keep((block_k, block_q), dropout_rate,
+                                     seed_ref[0, 0], (bh_idx, q_idx, kb))
+                p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+            acc_new = acc * alpha + jax.lax.dot_general(
+                v, p_use.astype(v.dtype), (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [d, bq]
+            return m_new, l_new, acc_new
+        return body
+
+    carry = (m_i, l_i, acc)
+    if causal or kv_partial:
+        carry = jax.lax.fori_loop(0, mask_lo, make_body(False), carry)
+        carry = jax.lax.fori_loop(mask_lo, num_kb, make_body(True), carry)
+    else:
+        carry = jax.lax.fori_loop(0, num_kb, make_body(False), carry)
+    m_i, l_i, acc = carry
     l_safe = jnp.maximum(l_i, 1e-30)
     o_ref[...] = (acc / l_safe).T.astype(o_ref.dtype)
     # row logsumexp for the backward's prob recomputation; the stats ref
@@ -181,51 +224,59 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     lse_okf = jnp.isfinite(lse).astype(jnp.float32)
     lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
 
-    def body(kb, dq):
-        # TRANSPOSED scores [bk, bq]: per-query lse/delta broadcast along
-        # LANES; dropout regenerates in the same layout as the fwd
-        k = k_ref[pl.dslice(kb * block_k, block_k), :]
-        v = v_ref[pl.dslice(kb * block_k, block_k), :]
-        st = jax.lax.dot_general(
-            k, q, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if bias_ref is not None:
-            b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
-            st = st + b.astype(jnp.float32)[:, None]
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 0)
-        mask = k_pos < kv_len
-        if causal:
-            q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_k, block_q), 1)
-            mask = mask & (q_pos >= k_pos)
-        p = jnp.where(mask, jnp.exp(st - lse_safe[None, :]),
-                      0.0) * lse_okf[None, :]
-        dp = jax.lax.dot_general(
-            v, do, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bk, bq] = V dO^T
-        if dropout_rate > 0.0:
-            keep = _dropout_keep((block_k, block_q), dropout_rate,
-                                 seed_ref[0, 0], (bh_idx, q_idx, kb))
-            dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
-        ds = p * (dp - delta[None, :])  # [bk, bq]
-        dq = dq + jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        return dq
-
     num_kb = kv_pad // block_k
     if causal:
         num_kb = jnp.minimum(
             num_kb, ((q_idx + 1) * block_q + block_k - 1) // block_k)
-    dq = jax.lax.fori_loop(0, num_kb, body,
-                           jnp.zeros((block_q, d), jnp.float32))
+    # mask specialization as in _fwd_kernel: only diagonal blocks (causal)
+    # and the padded kv tail are masked; interior iterations run lean
+    kv_partial = kv_len < kv_pad          # static
+    mask_lo = _kv_mask_lo(num_kb, q_idx, block_q, block_k, kv_len,
+                          kv_pad, causal)
+
+    def make_body(masked):
+        def body(kb, dq):
+            # TRANSPOSED scores [bk, bq]: per-query lse/delta broadcast
+            # along LANES; dropout regenerates in the same layout as fwd
+            k = k_ref[pl.dslice(kb * block_k, block_k), :]
+            v = v_ref[pl.dslice(kb * block_k, block_k), :]
+            st = jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if bias_ref is not None:
+                b = bias_ref[0, pl.dslice(kb * block_k, block_k)]
+                st = st + b.astype(jnp.float32)[:, None]
+            p = jnp.exp(st - lse_safe[None, :]) * lse_okf[None, :]
+            if masked:
+                mask = _kv_mask(kb, q_idx, block_q, block_k, kv_len,
+                                kv_pad, causal)
+                p = jnp.where(mask, p, 0.0)
+            dp = jax.lax.dot_general(
+                v, do, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bk, bq] = V dO^T
+            if dropout_rate > 0.0:
+                keep = _dropout_keep((block_k, block_q), dropout_rate,
+                                     seed_ref[0, 0], (bh_idx, q_idx, kb))
+                dp = jnp.where(keep, dp / (1.0 - dropout_rate), 0.0)
+            ds = p * (dp - delta[None, :])  # [bk, bq]
+            dq = dq + jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            return dq
+        return body
+
+    dq = jnp.zeros((block_q, d), jnp.float32)
+    if causal or kv_partial:
+        dq = jax.lax.fori_loop(0, mask_lo, make_body(False), dq)
+        dq = jax.lax.fori_loop(mask_lo, num_kb, make_body(True), dq)
+    else:
+        dq = jax.lax.fori_loop(0, num_kb, make_body(False), dq)
     dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
                     lse_ref, delta_ref, dk_ref, dv_ref, db_ref, *, block_q,
-                    causal, scale, kv_len, q_len, dropout_rate):
+                    causal, scale, kv_len, kv_pad, q_len, dropout_rate):
     from jax.experimental import pallas as pl
 
     k = k_ref[...]
@@ -238,67 +289,104 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
     # TRANSPOSED scores [bk, bq] (cf. _fwd_kernel): per-query lse/delta
     # broadcast along lanes; the per-key bias-grad reduction rides the
     # MXU as a ones-column dot instead of a per-iteration lane reduce
-    k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_k, block_q), 0)
     bias_blk = None
     if bias_ref is not None:
         bias_blk = bias_ref[0, pl.dslice(k_idx * block_k, block_k)]
 
-    def body(qb, carry):
-        dk, dv, db = carry
-        q = q_ref[pl.dslice(qb * block_q, block_q), :]
-        do = do_ref[pl.dslice(qb * block_q, block_q), :]
-        lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
-        delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
-        st = jax.lax.dot_general(
-            k, q, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bk, bq]
-        if bias_blk is not None:
-            st = st + bias_blk.astype(jnp.float32)[:, None]
-        mask = k_pos < kv_len
-        q_pos = qb * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_k, block_q), 1)
-        mask = mask & (q_pos < q_len)
-        if causal:
-            mask = mask & (q_pos >= k_pos)
-        lse_okf = jnp.isfinite(lse).astype(jnp.float32)
-        lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
-        p = jnp.where(mask, jnp.exp(st - lse_safe[None, :]),
-                      0.0) * lse_okf[None, :]
-        dp = jax.lax.dot_general(
-            v, do, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bk, bq]
-        p_drop = p
-        if dropout_rate > 0.0:
-            keep = _dropout_keep((block_k, block_q), dropout_rate,
-                                 seed_ref[0, 0], (bh_idx, qb, k_idx))
-            inv = 1.0 / (1.0 - dropout_rate)
-            p_drop = jnp.where(keep, p * inv, 0.0)
-            dp = jnp.where(keep, dp * inv, 0.0)
-        ds = p * (dp - delta[None, :])  # [bk, bq]
-        # bf16 operands on the transposed contractions: the MXU runs f32
-        # dots at a fraction of its bf16 rate
-        dv = dv + jax.lax.dot_general(
-            p_drop.astype(v.dtype), do.astype(v.dtype),
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [bk, d]
-        dk = dk + jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if db is not None:
-            db = db + jax.lax.dot_general(
-                ds, jnp.ones((1, block_q), jnp.float32),
-                (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32)  # [bk, 1]
-        return dk, dv, db
+    # Mask specialization: padded k rows only produce dk/dv/db rows the
+    # caller's unpad discards, so no per-iteration kv-tail mask — but a
+    # once-per-program [bk, 1] row-validity select keeps them FINITE
+    # (exp(st - lse) can overflow to inf for garbage rows, and debug-nans
+    # style finiteness checks see the pre-slice kernel outputs). The
+    # causal mask applies only to diagonal q blocks (the segment head)
+    # and the q-pad mask only to the final q block (the tail) — interior
+    # q blocks run the lean body.
+    kvalid = None
+    if kv_len < kv_pad:                    # static
+        kvalid = (k_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < kv_len
+
+    def make_body(masked):
+        def body(qb, carry):
+            dk, dv, db = carry
+            q = q_ref[pl.dslice(qb * block_q, block_q), :]
+            do = do_ref[pl.dslice(qb * block_q, block_q), :]
+            lse = lse_ref[0, pl.dslice(qb * block_q, block_q)]
+            delta = delta_ref[0, pl.dslice(qb * block_q, block_q)]
+            st = jax.lax.dot_general(
+                k, q, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bk, bq]
+            if bias_blk is not None:
+                st = st + bias_blk.astype(jnp.float32)[:, None]
+            lse_okf = jnp.isfinite(lse).astype(jnp.float32)
+            lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+            p = jnp.exp(st - lse_safe[None, :]) * lse_okf[None, :]
+            if kvalid is not None:
+                p = jnp.where(kvalid, p, 0.0)   # sublane-broadcast select
+            if masked:
+                q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                    jnp.int32, (block_k, block_q), 1)
+                mask = q_pos < q_len if q_len < q_pad else None
+                if causal:
+                    k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_k, block_q), 0)
+                    keep = q_pos >= k_pos
+                    mask = keep if mask is None else mask & keep
+                if mask is not None:
+                    p = jnp.where(mask, p, 0.0)
+            dp = jax.lax.dot_general(
+                v, do, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bk, bq]
+            p_drop = p
+            if dropout_rate > 0.0:
+                keep = _dropout_keep((block_k, block_q), dropout_rate,
+                                     seed_ref[0, 0], (bh_idx, qb, k_idx))
+                inv = 1.0 / (1.0 - dropout_rate)
+                p_drop = jnp.where(keep, p * inv, 0.0)
+                dp = jnp.where(keep, dp * inv, 0.0)
+            ds = p * (dp - delta[None, :])  # [bk, bq]
+            # bf16 operands on the transposed contractions: the MXU runs
+            # f32 dots at a fraction of its bf16 rate
+            dv = dv + jax.lax.dot_general(
+                p_drop.astype(v.dtype), do.astype(v.dtype),
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [bk, d]
+            dk = dk + jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if db is not None:
+                db = db + jax.lax.dot_general(
+                    ds, jnp.ones((1, block_q), jnp.float32),
+                    (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)  # [bk, 1]
+            return dk, dv, db
+        return body
 
     dk0 = jnp.zeros((block_k, d), jnp.float32)
     dv0 = jnp.zeros((block_k, d), jnp.float32)
     db0 = (jnp.zeros((block_k, 1), jnp.float32)
            if db_ref is not None else None)
+    qb_end = q_pad // block_q
     qb_lo = (k_idx * block_k) // block_q if causal else 0
-    dk, dv, db = jax.lax.fori_loop(qb_lo, q_pad // block_q, body,
-                                   (dk0, dv0, db0))
+    carry = (dk0, dv0, db0)
+    q_partial = q_len < q_pad             # static
+    if causal or q_partial:
+        # segment head: diagonal (causal) blocks, masked
+        if causal:
+            first_full = (k_idx * block_k + block_k - 1
+                          + block_q - 1) // block_q
+            a_hi = jnp.minimum(first_full, qb_end)
+        else:
+            a_hi = qb_lo
+        # segment middle: lean; segment tail: q-padded block(s), masked
+        pad_lo = (q_len // block_q) if q_partial else qb_end
+        b_hi = jnp.maximum(a_hi, jnp.minimum(pad_lo, qb_end))
+        carry = jax.lax.fori_loop(qb_lo, a_hi, make_body(True), carry)
+        carry = jax.lax.fori_loop(a_hi, b_hi, make_body(False), carry)
+        carry = jax.lax.fori_loop(b_hi, qb_end, make_body(True), carry)
+    else:
+        carry = jax.lax.fori_loop(qb_lo, qb_end, make_body(False), carry)
+    dk, dv, db = carry
     dk_ref[...] = dk.astype(dk_ref.dtype)
     dv_ref[...] = dv.astype(dv_ref.dtype)
     if db_ref is not None:
@@ -323,13 +411,24 @@ def _pad_vec(x, m):
 def _block_sizes(t, t_k):
     """Mosaic wants the lane (last) dim of 1-D stats blocks divisible by
     128, so real-TPU blocks are 128-multiples; interpret mode uses
-    8-multiples to exercise the padded-edge logic cheaply."""
+    8-multiples to exercise the padded-edge logic cheaply.
+    PADDLE_TPU_FLASH_BLOCK overrides the default cap (A/B knob). 512 is
+    the measured sweet spot at T=2048 (tools/attn_device_time.py: fwd
+    4.46 -> 2.18 ms vs 256-blocks, bwd 8.76 -> 5.86; 128 is 2.5x worse,
+    1024 regresses bwd) — bigger blocks amortize the per-iteration
+    MXU/VPU serialization across 4x the elements."""
     m = 8 if _INTERPRET else 128
+    default = 64 if _INTERPRET else 512   # small interpret cap keeps the
+    try:                                  # multi-block paths exercised
+        cap = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK", default))
+    except ValueError:
+        raise ValueError("PADDLE_TPU_FLASH_BLOCK must be an integer")
 
     def r(x):
         return ((x + m - 1) // m) * m
 
-    return min(256, r(t)), min(256, r(t_k))
+    cap = max(m, r(cap) if cap % m else cap)  # Mosaic lane divisibility
+    return min(cap, r(t)), min(cap, r(t_k))
 
 
 def _flash_fwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate):
@@ -459,7 +558,7 @@ def _flash_bwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate,
     # dK/dV: grid over k blocks
     dkv_kernel = functools.partial(
         _bwd_dkv_kernel, block_q=block_q, causal=causal, scale=scale,
-        kv_len=t_k, q_len=t, dropout_rate=dropout_rate)
+        kv_len=t_k, kv_pad=tk_pad, q_len=t, dropout_rate=dropout_rate)
 
     def dkv_entry(*refs):
         if biasp is not None:

@@ -153,6 +153,48 @@ def test_flash_2d_and_broadcast_bias_fallback(rng):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("causal,t,tk", [
+    (False, 200, 150),   # unaligned kv tail, multi-block both axes
+    (True, 200, 200),    # causal diagonal + unaligned tails
+    (False, 72, 200),    # q shorter than kv, kv tail masked
+])
+def test_flash_multiblock_unaligned_tails(rng, causal, t, tk,
+                                          monkeypatch):
+    """Sequences spanning several blocks with t % block != 0 exercise the
+    mask-specialized loop splits (unmasked interior / masked diagonal +
+    padded tails) in the three STREAMING kernels, fwd and bwd, with a key
+    bias. The dense-path ceiling is lowered so the block path engages at
+    these (interpret-tractable) lengths."""
+    monkeypatch.setattr(fa, "_DENSE_MAX_Q", 0)
+    monkeypatch.setattr(fa, "_DENSE_MAX_KV", 0)
+    b, h, d = 1, 2, 8
+    q, k, v = _mk(rng, b, h, t, tk, d)
+    lengths = np.array([tk - 5])
+    bias4 = np.where(np.arange(tk)[None] < lengths[:, None], 0.0, -1e9)
+    bias4 = jnp.asarray(bias4[:, None, None, :].astype("f4"))
+
+    def loss_flash(q, k, v):
+        o = fa.flash_attention(q, k, v, num_heads=h, bias=bias4,
+                               causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    def loss_ref(q, k, v):
+        o = _ref(q, k, v, h, bias=bias4, causal=causal)
+        return jnp.sum(o * jnp.cos(o))
+
+    np.testing.assert_allclose(
+        np.asarray(fa.flash_attention(q, k, v, num_heads=h, bias=bias4,
+                                      causal=causal)),
+        np.asarray(_ref(q, k, v, h, bias=bias4, causal=causal)),
+        rtol=5e-4, atol=5e-4)
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-2, atol=1e-3,
+                                   err_msg="d%s" % name)
+
+
 def test_flash_causal_multiblock_grads(rng):
     """Sequences spanning multiple 256-blocks exercise the causal
     block-skipping bounds in fwd, dQ and dK/dV kernels."""
