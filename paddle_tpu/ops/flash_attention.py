@@ -100,7 +100,9 @@ def _kv_mask_lo(num_kb, q_idx, block_q, block_k, kv_len, kv_pad, causal):
     _bwd_dq_kernel so their split arithmetic cannot drift apart."""
     mask_lo = num_kb
     if causal:
-        mask_lo = (q_idx * block_q) // block_k
+        # clamp to num_kb: for t_q > t_k the diagonal can lie beyond the
+        # last k block, and the lean prefix must never read past kv_pad
+        mask_lo = jnp.minimum(num_kb, (q_idx * block_q) // block_k)
     if kv_len < kv_pad:
         mask_lo = jnp.minimum(mask_lo, kv_len // block_k)
     return mask_lo

@@ -7,7 +7,6 @@ Variants: fwd/bwd x causal/full x drop0/drop1, fakeexp ablations.
 """
 import os
 import sys
-import time
 import shutil
 from collections import defaultdict
 
