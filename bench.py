@@ -188,7 +188,8 @@ def _bench_bert_dygraph(on_tpu):
     import paddle_tpu as fluid
     with fluid.dygraph.guard():
         model(*feeds)  # materialize lazily-built params
-    step, params, opt_state = bert_dygraph.make_train_step(model)
+    step, params, opt_state = bert_dygraph.make_train_step(
+        model, optimizer=os.environ.get("BENCH_DYGRAPH_OPT", "adam"))
     jstep = jax.jit(step, donate_argnums=(0, 1))
     feeds = tuple(jax.device_put(f) for f in feeds)
     key = jax.random.PRNGKey(0)

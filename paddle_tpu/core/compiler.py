@@ -76,8 +76,10 @@ class CompiledProgram:
         devices — the analog of ParallelExecutor claiming all visible GPUs.
 
         ``sequence_feeds``: with ``sp_axis`` set, the feed names whose dim 1
-        is the sequence axis to shard. Default None falls back to a
-        longest-dim-1 heuristic (a warning names the classified feeds)."""
+        is the sequence axis to shard — model specs carry them as
+        ``spec.sequence_feeds``. With None, feeds shard on dp only,
+        unless PADDLE_TPU_SP_HEURISTIC=1 opts into the longest-dim-1
+        shape guess (a warning names the classified feeds)."""
         self._build_strategy = build_strategy or BuildStrategy()
         self._exec_strategy = exec_strategy or ExecutionStrategy()
         self._dp_axis = dp_axis

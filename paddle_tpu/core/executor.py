@@ -535,13 +535,18 @@ class Executor:
         # sequence-parallel feeds: axis 1 of [B,S,...] sequence feeds -> sp
         # (ring-attention-style context sharding; GSPMD all-gathers where an
         # op needs the full sequence). Callers name the sequence feeds
-        # explicitly via with_data_parallel(sequence_feeds=...); without an
-        # annotation the feeds whose dim 1 equals the longest candidate dim
-        # (the model's seq length) are classified, with a warning naming
-        # them — labels [B,1] / field-id feeds stay dp-only.
+        # explicitly via with_data_parallel(sequence_feeds=...) — model
+        # specs carry them as ``spec.sequence_feeds``. The shape-based
+        # guess (feeds whose dim 1 equals the longest candidate dim) is
+        # OPT-IN via PADDLE_TPU_SP_HEURISTIC=1: a [B,S] integer feed at a
+        # different length would shard wrong, so guessing must be asked
+        # for. Without either, feeds shard on dp only.
+        from .op_registry import env_flag
+
         gb = program.global_block()
         sp_names = set(seq_feeds or ())
-        if sp_size is not None and seq_feeds is None:
+        if (sp_size is not None and seq_feeds is None
+                and env_flag("PADDLE_TPU_SP_HEURISTIC")):
             seq_dim = None
             dims = [gb.var(n).shape[1] for n in feed_names
                     if gb.has_var(n) and gb.var(n).shape is not None

@@ -21,6 +21,7 @@ OP_IMPLS = {}
 RNG_KEY = "@RNG@"
 RNG0_KEY = "@RNG0@"  # snapshot at step start, used for autodiff replay
 ENV0_KEY = "@ENV0@"  # dict snapshot of env at step start (autodiff replay base)
+REPLAY_KEY = "@REPLAY@"  # set in autodiff replay envs (debug ops dedup)
 PP_KEY = "@PP@"      # pipeline-parallel config (mesh, axis, boundaries, ...)
 GRAD_SCALE_KEY = "@GRAD_SCALE@"  # BuildStrategy.GradientScaleStrategy
 

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..op_registry import (register, get, put, run_op, RNG_KEY, RNG0_KEY,
-                           ENV0_KEY, PP_KEY, GRAD_SCALE_KEY)
+                           ENV0_KEY, PP_KEY, GRAD_SCALE_KEY, REPLAY_KEY)
 
 
 def _loss_seed(env, loss_name, loss_val):
@@ -97,11 +97,6 @@ def _autodiff(env, op):
         # the GPipe reverse schedule. Only the loss is re-exported — any
         # other fetched forward output falls back to the (replicated)
         # outer trace, and unfetched outer compute is DCE'd by XLA.
-        if sites:
-            raise NotImplementedError(
-                "sparse gradients are not supported under pipeline "
-                "parallelism yet; unset is_sparse_grad on %s"
-                % sorted(sparse_names))
         from ...parallel.pipeline import pipeline_program_loss
 
         pp_loss = pipeline_program_loss(
@@ -112,7 +107,14 @@ def _autodiff(env, op):
             # recompute each microbatch's stages in the backward instead of
             # keeping every scan-stashed activation live
             pp_loss = jax.checkpoint(pp_loss)
-        args = {n: env[n] for n in dense_wrt}
+        # Sparse tables DENSIFY under pipeline parallelism: the GPipe scan
+        # accumulates a dense table grad (the per-lookup delta trick can't
+        # thread through the microbatch scan carry), and the (rows,
+        # values) contract is preserved with rows = arange(V) — exact for
+        # every optimizer path (scatter-add of all rows == dense update),
+        # at the documented cost of full-table grad traffic per step.
+        pp_wrt = dense_wrt + sorted(sparse_names)
+        args = {n: env[n] for n in pp_wrt}
         grads_w, aux = jax.grad(pp_loss, has_aux=True)(args)
         env.update(aux)
         callback = op.attr("grad_callback")
@@ -121,6 +123,11 @@ def _autodiff(env, op):
             if callback is not None:
                 g = callback(name, g)
             put(env, v, g)
+            if name in sparse_names:
+                rv = getattr(v, "sparse_rows_var", None)
+                if rv is not None:
+                    env[rv.name] = jnp.arange(env[name].shape[0],
+                                              dtype=jnp.int32)
         return
 
     def loss_fn(args):
@@ -129,6 +136,7 @@ def _autodiff(env, op):
         # base, or they'd fall back to the mid-replay env and double-apply
         # in-place ops (the bug the step-start snapshot exists to prevent)
         local[ENV0_KEY] = base_env
+        local[REPLAY_KEY] = True
         local.update(args["w"])
         if rng0 is not None:
             local[RNG_KEY] = rng0
@@ -214,6 +222,7 @@ def _autodiff_vjp(env, op):
     def f(wrt_vals):
         local = dict(base_env)
         local[ENV0_KEY] = base_env
+        local[REPLAY_KEY] = True
         local.update(wrt_vals)
         if rng0 is not None:
             local[RNG_KEY] = rng0

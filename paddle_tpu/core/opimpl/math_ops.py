@@ -286,10 +286,17 @@ def _mul(env, op):
     y = get(env, op.input("Y"))
     xnc = op.attr("x_num_col_dims", 1)
     ync = op.attr("y_num_col_dims", 1)
-    import numpy as _np
+    import functools as _ft
+    import operator as _operator
+
+    def _prod(dims):
+        # NOT np.prod: symbolic batch dims (jax.export shape polymorphism)
+        # must stay symbolic through the reshape
+        return _ft.reduce(_operator.mul, dims, 1)
+
     xs, ys = x.shape, y.shape
-    x2 = x.reshape((int(_np.prod(xs[:xnc])), int(_np.prod(xs[xnc:]))))
-    y2 = y.reshape((int(_np.prod(ys[:ync])), int(_np.prod(ys[ync:]))))
+    x2 = x.reshape((_prod(xs[:xnc]), _prod(xs[xnc:])))
+    y2 = y.reshape((_prod(ys[:ync]), _prod(ys[ync:])))
     from ..op_registry import mxu_cast, mxu_acc_dtype
     x2, y2 = mxu_cast(x2, y2)
     out = jnp.matmul(x2, y2, preferred_element_type=mxu_acc_dtype(x2))

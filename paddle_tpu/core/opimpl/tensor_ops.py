@@ -15,10 +15,16 @@ from ..op_registry import register, get, get_list, put, next_rng
 from ..framework import convert_np_dtype
 
 
+def _canonical(dtype):
+    """x64 is disabled: pre-canonicalize 64-bit requests to the jax
+    default width instead of letting jnp warn-and-truncate every call."""
+    return jax.dtypes.canonicalize_dtype(dtype)
+
+
 @register("fill_constant")
 def _fill_constant(env, op):
     shape = op.attr("shape")
-    dtype = convert_np_dtype(op.attr("dtype", "float32"))
+    dtype = _canonical(convert_np_dtype(op.attr("dtype", "float32")))
     value = op.attr("value", 0.0)
     put(env, op.output("Out"), jnp.full(tuple(shape), value, dtype=dtype))
 
@@ -30,8 +36,9 @@ def _fill_constant_batch_size_like(env, op):
     in_idx = op.attr("input_dim_idx", 0)
     out_idx = op.attr("output_dim_idx", 0)
     shape[out_idx] = ref.shape[in_idx]
-    dtype = convert_np_dtype(op.attr("dtype", "float32"))
-    put(env, op.output("Out"), jnp.full(tuple(shape), op.attr("value", 0.0), dtype=dtype))
+    dtype = _canonical(convert_np_dtype(op.attr("dtype", "float32")))
+    put(env, op.output("Out"), jnp.full(tuple(shape), op.attr("value", 0.0),
+                                        dtype=dtype))
 
 
 @register("fill_zeros_like")

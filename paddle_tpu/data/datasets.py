@@ -166,11 +166,73 @@ class mnist:
         return mnist._make(n, "mnist-test", "test")
 
 
+def _cached_archive(module, fname, url, md5):
+    """Resolve a dataset archive: pre-seeded ``DATA_HOME/<module>/`` cache
+    first (taken as-is — pre-seeding with subset/mirror archives is the
+    documented offline path); a real download only when
+    PADDLE_TPU_DATASET_DOWNLOAD=1, md5-validated against the pinned hash
+    (ref ``dataset/common.py:download``)."""
+    from .common import DATA_HOME, download
+
+    p = os.path.join(DATA_HOME, module, fname)
+    if os.path.exists(p):
+        return p
+    if not os.environ.get("PADDLE_TPU_DATASET_DOWNLOAD"):
+        raise FileNotFoundError(
+            "no cached %s under %s (pre-seed the cache or set "
+            "PADDLE_TPU_DATASET_DOWNLOAD=1 to fetch)"
+            % (fname, os.path.join(DATA_HOME, module)))
+    return download(url, module, md5, save_name=fname)
+
+
 class cifar10:
-    """3x32x32 images; schema parity with ``dataset/cifar.py``."""
+    """3x32x32 images; schema parity with ``dataset/cifar.py`` (real
+    cifar-10-python.tar.gz from the cache when primed, procedural
+    prototypes otherwise)."""
+
+    URL = "https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz"
+    MD5 = "c58f30108f718f92721af3b95e74349a"
+    _cache = {}
 
     @staticmethod
-    def _make(n, tag):
+    def _real(sub_name):
+        import pickle
+        import tarfile
+
+        path = _cached_archive("cifar", "cifar-10-python.tar.gz",
+                               cifar10.URL, cifar10.MD5)
+        key = (path, sub_name)
+        if key in cifar10._cache:  # the tar.gz costs a full decompress
+            return cifar10._cache[key]
+        xs, ys = [], []
+        with tarfile.open(path, mode="r") as f:
+            for item in f:
+                if sub_name not in item.name:
+                    continue
+                batch = pickle.load(f.extractfile(item), encoding="bytes")
+                xs.append(batch[b"data"])
+                ys.extend(int(l) for l in batch[b"labels"])
+        if not xs:
+            raise RuntimeError("no %s batches in %s" % (sub_name, path))
+        data = np.concatenate(xs, axis=0)
+        out = (data, np.asarray(ys, dtype="int64"))
+        cifar10._cache[key] = out
+        return out
+
+    @staticmethod
+    def _make(n, tag, sub_name):
+        try:
+            data, labels = cifar10._real(sub_name)
+
+            def real_reader():
+                m = min(n, len(data)) if n else len(data)
+                for i in range(m):
+                    # ref cifar.py read_batch: (sample/255).astype(f32)
+                    yield (data[i] / 255.0).astype("float32"), labels[i]
+
+            return real_reader
+        except (FileNotFoundError, RuntimeError):
+            pass
         r = _rng(tag)
         protos = r.normal(0, 1, (10, 3 * 32 * 32)).astype("float32")
 
@@ -184,11 +246,11 @@ class cifar10:
 
     @staticmethod
     def train10(n=1024):
-        return cifar10._make(n, "cifar-train")
+        return cifar10._make(n, "cifar-train", "data_batch")
 
     @staticmethod
     def test10(n=256):
-        return cifar10._make(n, "cifar-test")
+        return cifar10._make(n, "cifar-test", "test_batch")
 
 
 class flowers:
@@ -233,16 +295,100 @@ class uci_housing:
 
 
 class imdb:
-    """Sentiment: (word-id sequence, label) (ref ``dataset/imdb.py``)."""
+    """Sentiment: (word-id sequence, label) (ref ``dataset/imdb.py`` —
+    aclImdb_v1.tar.gz from the cache when primed: tokenize + build_dict
+    with the reference's cutoff/ordering, labels pos=0 / neg=1)."""
 
+    URL = ("http://ai.stanford.edu/%7Eamaas/data/sentiment/"
+           "aclImdb_v1.tar.gz")
+    MD5 = "7c2ac02c03563afcf9b574c7e56c153a"
     word_dict_size = 5149
+    _cache = {}
+
+    @staticmethod
+    def _tokenize(tarf, pattern):
+        import re
+        import tarfile  # noqa: F401
+
+        pat = re.compile(pattern)
+        for tf in tarf:
+            if tf.isfile() and pat.match(tf.name):
+                doc = tarf.extractfile(tf).read().rstrip(b"\n\r").lower()
+                yield doc.translate(None, b"!\"#$%&'()*+,-./:;<=>?@[\\]^_"
+                                    b"`{|}~").split()
+
+    @staticmethod
+    def _real_dict(cutoff=150):
+        """ref imdb.py build_dict over train/{pos,neg}: frequency-sorted
+        ids + trailing <unk>."""
+        if "dict" in imdb._cache:
+            return imdb._cache["dict"]
+        import collections
+        import tarfile
+
+        path = _cached_archive("imdb", "aclImdb_v1.tar.gz", imdb.URL,
+                               imdb.MD5)
+        freq = collections.defaultdict(int)
+        with tarfile.open(path) as tarf:
+            for doc in imdb._tokenize(tarf,
+                                      r"aclImdb/train/(pos|neg)/.*\.txt$"):
+                for w in doc:
+                    freq[w] += 1
+        pairs = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                       key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(pairs)}
+        word_idx[b"<unk>"] = len(word_idx)
+        imdb._cache["dict"] = word_idx
+        return word_idx
 
     @staticmethod
     def word_dict():
-        return {i: i for i in range(imdb.word_dict_size)}
+        try:
+            return imdb._real_dict()
+        except (FileNotFoundError, RuntimeError):
+            return {i: i for i in range(imdb.word_dict_size)}
 
     @staticmethod
-    def _make(n, tag, maxlen=100):
+    def _real(split, word_idx, n):
+        import tarfile
+
+        path = _cached_archive("imdb", "aclImdb_v1.tar.gz", imdb.URL,
+                               imdb.MD5)
+        key = (path, split, id(word_idx), n)
+        if key in imdb._cache:
+            return imdb._cache[key]
+        unk = word_idx[b"<unk>"]
+        out = []
+        # per-tag cap: a global cap would let pos fill the whole quota
+        # and return a near-single-class dataset
+        per_tag = ((n + 1) // 2) if n else 0
+        with tarfile.open(path) as tarf:
+            for label, tag in ((0, "pos"), (1, "neg")):
+                pat = r"aclImdb/%s/%s/.*\.txt$" % (split, tag)
+                taken = 0
+                for doc in imdb._tokenize(tarf, pat):
+                    out.append((np.asarray(
+                        [word_idx.get(w, unk) for w in doc],
+                        dtype="int64"), np.int64(label)))
+                    taken += 1
+                    if per_tag and taken >= per_tag:
+                        break
+        imdb._cache[key] = out
+        return out
+
+    @staticmethod
+    def _make(n, tag, split, word_dict=None, maxlen=100):
+        try:
+            wd = word_dict or imdb._real_dict()
+            samples = imdb._real(split, wd, n)
+
+            def real_reader():
+                for s in samples:
+                    yield s
+
+            return real_reader
+        except (FileNotFoundError, RuntimeError, KeyError):
+            pass
         r = _rng(tag)
 
         def reader():
@@ -257,11 +403,11 @@ class imdb:
 
     @staticmethod
     def train(word_dict=None, n=512):
-        return imdb._make(n, "imdb-train")
+        return imdb._make(n, "imdb-train", "train", word_dict)
 
     @staticmethod
     def test(word_dict=None, n=128):
-        return imdb._make(n, "imdb-test")
+        return imdb._make(n, "imdb-test", "test", word_dict)
 
 
 class imikolov:

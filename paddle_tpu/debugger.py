@@ -1,0 +1,58 @@
+"""Program visualization (ref ``python/paddle/fluid/debugger.py:222``
+``draw_block_graphviz`` + ``graphviz.py``): dump a Block as a Graphviz
+.dot file — op nodes (boxes), var nodes (ellipses), dataflow edges.
+Pure-text emission; render with any dot binary or viewer."""
+
+__all__ = ["draw_block_graphviz", "pprint_program_codes"]
+
+
+def _esc(s):
+    return str(s).replace('"', r"\"")
+
+
+def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
+    """Write ``block``'s dataflow graph to ``path`` (DOT format).
+    ``highlights``: iterable of var names to fill red."""
+    highlights = set(highlights or ())
+    lines = ["digraph G {", "  rankdir=TB;"]
+    var_ids = {}
+
+    def var_node(name):
+        if name in var_ids:
+            return var_ids[name]
+        nid = "var_%d" % len(var_ids)
+        var_ids[name] = nid
+        v = block.var(name) if block.has_var(name) else None
+        label = name
+        if v is not None and getattr(v, "shape", None) is not None:
+            label += r"\n%s %s" % (tuple(v.shape),
+                                   getattr(v, "dtype", ""))
+        style = ', style=filled, fillcolor="red"' if name in highlights \
+            else ""
+        lines.append('  %s [label="%s", shape=ellipse%s];'
+                     % (nid, _esc(label), style))
+        return nid
+
+    for i, op in enumerate(block.ops):
+        op_id = "op_%d" % i
+        lines.append('  %s [label="%s", shape=box, style=filled, '
+                     'fillcolor="lightgray"];' % (op_id, _esc(op.type)))
+        for name in op.input_arg_names:
+            lines.append("  %s -> %s;" % (var_node(name), op_id))
+        for name in op.output_arg_names:
+            lines.append("  %s -> %s;" % (op_id, var_node(name)))
+    lines.append("}")
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def pprint_program_codes(program):
+    """Print each block's ops in a readable pseudo-code form (ref
+    ``debugger.py`` pprint_program_codes)."""
+    for block in program.blocks:
+        print("// block %d" % block.idx)
+        for op in block.ops:
+            outs = ", ".join(op.output_arg_names)
+            ins = ", ".join(op.input_arg_names)
+            print("%s = %s(%s)" % (outs, op.type, ins))

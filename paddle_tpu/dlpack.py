@@ -1,0 +1,25 @@
+"""DLPack interop (ref ``paddle/fluid/framework/dlpack_tensor.h`` +
+``fluid.core.to_dlpack``): zero-copy tensor exchange with other
+frameworks. TPU-native: jax arrays already speak DLPack — these wrappers
+give the fluid-named surface (and accept framework tensors like torch's
+directly via the standard ``__dlpack__`` protocol)."""
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(tensor):
+    """A DLPack capsule (or ``__dlpack__``-bearing array) for ``tensor``.
+    jax arrays implement ``__dlpack__``; consumers
+    (``torch.utils.dlpack.from_dlpack``, ``np.from_dlpack``) take the
+    array directly."""
+    arr = jnp.asarray(tensor)
+    return arr
+
+
+def from_dlpack(ext_tensor):
+    """Import an external DLPack-capable tensor (torch/numpy/capsule) as
+    a jax array, zero-copy when the producer's memory is addressable."""
+    return jax.dlpack.from_dlpack(ext_tensor)

@@ -10,4 +10,5 @@ from .base import (guard, to_variable, enabled, no_grad,  # noqa: F401
 from .layers import Layer  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
-from .optimizer import SGDOptimizer, AdamOptimizer  # noqa: F401
+from .optimizer import (SGDOptimizer, AdamOptimizer,  # noqa: F401
+                        MomentumOptimizer, AdagradOptimizer, LambOptimizer)

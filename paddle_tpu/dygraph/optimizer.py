@@ -3,18 +3,33 @@
 
 Tape-native: ``minimize(loss)`` runs ``loss.backward()`` (unless grads are
 already populated), applies the update to each parameter's value in place,
-and clears gradients."""
+and clears gradients. Update rules mirror the static kernels
+(``core/opimpl/optimizer_ops.py`` — ref ``operators/optimizers/``);
+``regularization=L2Decay(c)`` folds ``c * p`` into the grad like the
+static ``append_regularization_ops`` pass."""
 
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["SGDOptimizer", "AdamOptimizer"]
+__all__ = ["SGDOptimizer", "AdamOptimizer", "MomentumOptimizer",
+           "AdagradOptimizer", "LambOptimizer"]
 
 
 class _DygraphOptimizer:
-    def __init__(self, learning_rate, parameter_list):
+    def __init__(self, learning_rate, parameter_list, regularization=None):
         self._lr = learning_rate
         self._params = list(parameter_list)
+        self._reg = regularization
+
+    def _grad(self, p):
+        g = p._grad
+        if self._reg is not None:
+            coeff = getattr(self._reg, "_coeff", 0.0)
+            if type(self._reg).__name__.startswith("L1"):
+                g = g + coeff * jnp.sign(p._value)
+            else:
+                g = g + coeff * p._value
+        return g
 
     def minimize(self, loss, startup_program=None, parameter_list=None):
         if all(p._grad is None for p in self._params):
@@ -32,13 +47,52 @@ class _DygraphOptimizer:
 
 class SGDOptimizer(_DygraphOptimizer):
     def _apply(self, p):
-        p._value = p._value - self._lr * p._grad
+        p._value = p._value - self._lr * self._grad(p)
+
+
+class MomentumOptimizer(_DygraphOptimizer):
+    """Ref ``momentum_op.cc``: velocity accumulation (+ Nesterov)."""
+
+    def __init__(self, learning_rate=1e-3, momentum=0.9,
+                 use_nesterov=False, parameter_list=(),
+                 regularization=None):
+        super().__init__(learning_rate, parameter_list, regularization)
+        self._mu = momentum
+        self._nesterov = use_nesterov
+        self._vel = {}
+
+    def _apply(self, p):
+        g = self._grad(p)
+        v = self._vel.get(id(p), jnp.zeros_like(p._value))
+        v = self._mu * v + g
+        self._vel[id(p)] = v
+        if self._nesterov:
+            p._value = p._value - self._lr * (g + self._mu * v)
+        else:
+            p._value = p._value - self._lr * v
+
+
+class AdagradOptimizer(_DygraphOptimizer):
+    """Ref ``adagrad_op.cc``: accumulated squared grads."""
+
+    def __init__(self, learning_rate=1e-2, epsilon=1e-6,
+                 parameter_list=(), regularization=None):
+        super().__init__(learning_rate, parameter_list, regularization)
+        self._eps = epsilon
+        self._acc = {}
+
+    def _apply(self, p):
+        g = self._grad(p)
+        a = self._acc.get(id(p), jnp.zeros_like(p._value))
+        a = a + g * g
+        self._acc[id(p)] = a
+        p._value = p._value - self._lr * g / (jnp.sqrt(a) + self._eps)
 
 
 class AdamOptimizer(_DygraphOptimizer):
     def __init__(self, learning_rate=1e-3, beta1=0.9, beta2=0.999,
-                 epsilon=1e-8, parameter_list=()):
-        super().__init__(learning_rate, parameter_list)
+                 epsilon=1e-8, parameter_list=(), regularization=None):
+        super().__init__(learning_rate, parameter_list, regularization)
         self._b1, self._b2, self._eps = beta1, beta2, epsilon
         self._m = {}
         self._v = {}
@@ -52,9 +106,46 @@ class AdamOptimizer(_DygraphOptimizer):
         k = id(p)
         m = self._m.get(k, jnp.zeros_like(p._value))
         v = self._v.get(k, jnp.zeros_like(p._value))
-        g = p._grad
+        g = self._grad(p)
         m = self._b1 * m + (1 - self._b1) * g
         v = self._b2 * v + (1 - self._b2) * g * g
         self._m[k], self._v[k] = m, v
         corr = np.sqrt(1 - self._b2 ** self._t) / (1 - self._b1 ** self._t)
         p._value = p._value - self._lr * corr * m / (jnp.sqrt(v) + self._eps)
+
+
+class LambOptimizer(_DygraphOptimizer):
+    """LAMB (same rule as the static ``lamb`` kernel,
+    ``optimizer_ops.py:_lamb``): adam direction + decoupled weight decay,
+    scaled by the layer-wise trust ratio — the BERT-pretraining
+    optimizer at TPU-pod batch sizes."""
+
+    def __init__(self, learning_rate=1e-3, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6,
+                 parameter_list=(), regularization=None):
+        super().__init__(learning_rate, parameter_list, regularization)
+        self._wd = lamb_weight_decay
+        self._b1, self._b2, self._eps = beta1, beta2, epsilon
+        self._m = {}
+        self._v = {}
+        self._t = 0
+
+    def minimize(self, loss, startup_program=None, parameter_list=None):
+        self._t += 1
+        super().minimize(loss, startup_program, parameter_list)
+
+    def _apply(self, p):
+        k = id(p)
+        m = self._m.get(k, jnp.zeros_like(p._value))
+        v = self._v.get(k, jnp.zeros_like(p._value))
+        g = self._grad(p)
+        m = self._b1 * m + (1 - self._b1) * g
+        v = self._b2 * v + (1 - self._b2) * g * g
+        self._m[k], self._v[k] = m, v
+        m_hat = m / (1 - self._b1 ** self._t)
+        v_hat = v / (1 - self._b2 ** self._t)
+        r = m_hat / (jnp.sqrt(v_hat) + self._eps) + self._wd * p._value
+        p_norm = jnp.sqrt(jnp.sum(jnp.square(p._value)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        p._value = p._value - self._lr * trust * r

@@ -17,7 +17,8 @@ import os
 from . import io as io_mod
 from .core.executor import Executor, Scope, scope_guard, XLAPlace
 
-__all__ = ["AnalysisConfig", "Predictor", "create_paddle_predictor"]
+__all__ = ["AnalysisConfig", "Predictor", "create_paddle_predictor",
+           "StableHLOPredictor", "load_stablehlo_predictor"]
 
 
 class AnalysisConfig:
@@ -139,3 +140,68 @@ class Predictor:
 def create_paddle_predictor(config):
     """Factory-name parity with the reference C-API."""
     return Predictor(config)
+
+
+class StableHLOPredictor:
+    """Serves from the serialized StableHLO artifact alone — no
+    model-building Python, no symbolic program replay (ref parity:
+    ``CreatePaddlePredictor`` runs from the serialized program+params,
+    ``analysis_predictor.cc:734``). ``save_inference_model`` writes the
+    artifact (``model.stablehlo.bin`` via jax.export) next to the params;
+    this loader deserializes and executes it.
+
+    Batch-size note: with a symbolic-batch export (manifest
+    ``batch_mode: symbolic``) any batch works; a ``pinned-1`` export only
+    accepts batch 1."""
+
+    def __init__(self, dirname, params_filename=None):
+        import json
+
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import export as jexport
+
+        with open(os.path.join(dirname, "model.stablehlo.bin"), "rb") as f:
+            self._exported = jexport.deserialize(f.read())
+        with open(os.path.join(dirname, "stablehlo_manifest.json")) as f:
+            man = json.load(f)
+        self.feed_names = list(man["feed_names"])
+        self.fetch_names = list(man["fetch_names"])
+        self.batch_mode = man["batch_mode"]
+        params = np.load(os.path.join(dirname,
+                                      params_filename or "params.npz"),
+                         allow_pickle=False)
+        state_names = man["state_names"]
+        missing = [n for n in state_names if n not in params]
+        if missing:
+            raise ValueError("params file lacks exported state vars %s"
+                             % missing)
+        self._state = {n: jnp.asarray(params[n]) for n in state_names}
+
+    def run(self, inputs, return_numpy=True):
+        import jax.numpy as jnp
+        import numpy as np
+
+        if isinstance(inputs, (list, tuple)):
+            feed = dict(zip(self.feed_names, inputs))
+        else:
+            feed = dict(inputs)
+        missing = set(self.feed_names) - set(feed)
+        if missing:
+            raise ValueError("missing feeds: %s" % sorted(missing))
+        feed = {n: jnp.asarray(feed[n]) for n in self.feed_names}
+        out = self._exported.call(self._state, feed)
+        return [np.asarray(o) for o in out] if return_numpy else list(out)
+
+    predict = run
+
+    def get_input_names(self):
+        return list(self.feed_names)
+
+    def get_output_names(self):
+        return list(self.fetch_names)
+
+
+def load_stablehlo_predictor(dirname, params_filename=None):
+    """Load-and-run from the ``save_inference_model`` StableHLO artifact."""
+    return StableHLOPredictor(dirname, params_filename)

@@ -13,6 +13,7 @@ import json
 import os
 import pickle
 import tempfile
+import warnings
 
 import numpy as np
 
@@ -132,27 +133,36 @@ def save_inference_model(dirname, feeded_var_names, target_vars, executor,
     with open(os.path.join(dirname, model_filename or "__model__"), "wb") as f:
         pickle.dump(model, f)
 
-    # StableHLO export (the XLA-native serialized program)
+    # StableHLO export (the XLA-native serialized program). Loud on
+    # failure — a missing artifact must not be discovered at serve time
+    # (ref parity: CreatePaddlePredictor serves from the serialized
+    # program alone, analysis_predictor.cc:734).
     try:
         _export_stablehlo(dirname, pruned, feeded_var_names,
                           [v.name for v in target_vars])
-    except Exception:
-        pass
+    except Exception as e:  # noqa: BLE001 — export is best-effort, but loud
+        warnings.warn("StableHLO export skipped: %s: %s"
+                      % (type(e).__name__, e))
     return [v.name for v in target_vars]
 
 
 def _export_stablehlo(dirname, program, feed_names, fetch_names):
+    """Serialize the pruned inference program via ``jax.export``:
+    ``model.stablehlo.bin`` (deserializable, executable artifact — see
+    ``inference.load_stablehlo_predictor``) plus ``model.stablehlo.mlir``
+    (human-readable text). The batch dim exports SYMBOLICALLY ('b') when
+    the program supports shape polymorphism; otherwise it falls back to a
+    pinned batch of 1 with a warning, recorded in the manifest."""
     import jax
-    import jax.numpy as jnp
+    from jax import export as jexport
     from .core.op_registry import run_op, RNG_KEY, RNG0_KEY
 
     gb = program.global_block()
-    shapes = {}
     for n in feed_names:
         v = gb.var(n)
         if v.shape is None or any(s < 0 for s in v.shape[1:]):
-            return
-        shapes[n] = (tuple(1 if s == -1 else s for s in v.shape), v.dtype)
+            raise ValueError(
+                "feed %r has non-static non-batch dims %s" % (n, v.shape))
     scope = global_scope()
     state = {v.name: scope.get(v.name) for v in program.list_vars()
              if v.persistable and v.name in scope}
@@ -163,13 +173,42 @@ def _export_stablehlo(dirname, program, feed_names, fetch_names):
         env[RNG_KEY] = jax.random.PRNGKey(0)
         env[RNG0_KEY] = env[RNG_KEY]
         for op in gb.ops:
+            if op.type == "print":
+                # debug prints are host callbacks — unserializable and
+                # not part of the served computation; identity them out
+                env[op.output("Out").name] = env[op.input("In").name]
+                continue
             run_op(env, op)
         return tuple(env[n] for n in fetch_names)
 
-    feed_spec = {n: jax.ShapeDtypeStruct(s, d) for n, (s, d) in shapes.items()}
-    lowered = jax.jit(fn).lower(state, feed_spec)
+    def feed_spec(batch):
+        out = {}
+        for n in feed_names:
+            v = gb.var(n)
+            out[n] = jax.ShapeDtypeStruct((batch,) + tuple(v.shape[1:]),
+                                          v.dtype)
+        return out
+
+    try:
+        b = jexport.symbolic_shape("b")[0]
+        exported = jexport.export(jax.jit(fn))(state, feed_spec(b))
+        batch_mode = "symbolic"
+    except Exception as e:  # noqa: BLE001 — fall back to a pinned batch
+        warnings.warn(
+            "symbolic-batch StableHLO export failed (%s: %s); exporting "
+            "with batch pinned to 1" % (type(e).__name__, e))
+        exported = jexport.export(jax.jit(fn))(state, feed_spec(1))
+        batch_mode = "pinned-1"
+
+    with open(os.path.join(dirname, "model.stablehlo.bin"), "wb") as f:
+        f.write(exported.serialize())
     with open(os.path.join(dirname, "model.stablehlo.mlir"), "w") as f:
-        f.write(lowered.as_text())
+        f.write(exported.mlir_module())
+    with open(os.path.join(dirname, "stablehlo_manifest.json"), "w") as f:
+        json.dump({"feed_names": list(feed_names),
+                   "fetch_names": list(fetch_names),
+                   "batch_mode": batch_mode,
+                   "state_names": sorted(state)}, f, indent=1)
 
 
 def load_inference_model(dirname, executor, model_filename=None,

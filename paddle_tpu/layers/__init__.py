@@ -11,6 +11,8 @@ from . import metric_op
 from . import sequence_lod
 from . import learning_rate_scheduler
 from . import math_op_patch  # noqa: F401
+from . import debug_ops
+from .debug_ops import Print, py_func  # noqa: F401
 
 from .nn import *  # noqa: F401,F403
 from .ops import *  # noqa: F401,F403

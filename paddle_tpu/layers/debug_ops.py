@@ -1,0 +1,175 @@
+"""Debug-surface ops: Print and py_func.
+
+Reference: ``paddle/fluid/operators/print_op.cc`` (+ the Python wrapper
+``layers/control_flow.py:146``) and ``operators/py_func_op.cc``
+(``layers/nn.py:10346``). TPU-native: Print rides an ordered host
+callback inside the jitted step (jax.debug/io_callback — the XLA analog
+of the reference's CPU-side TensorPrint), py_func rides
+``jax.pure_callback`` with an optional ``backward_func`` realized as a
+``custom_vjp`` whose cotangent is computed by a second host callback —
+the same (x, out, dout) -> dx contract as the reference grad kernel.
+"""
+
+import numpy as np
+
+import jax
+from jax.experimental import io_callback
+
+from ..core.layer_helper import LayerHelper
+from ..core.op_registry import register, get, put, REPLAY_KEY
+
+__all__ = ["Print", "py_func"]
+
+
+def Print(input, first_n=-1, message=None, summarize=-1,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Print the tensor (and/or its gradient) whenever it flows. Returns
+    the wrapped tensor (identity in the compute graph)."""
+    helper = LayerHelper("print", name=None)
+    out = helper.create_variable_for_type_inference(
+        dtype=str(input.dtype), shape=input.shape)
+    helper.append_op(
+        "print", {"In": input}, {"Out": out},
+        {"first_n": first_n, "message": message or "",
+         "summarize": summarize, "print_tensor_name": print_tensor_name,
+         "print_tensor_type": print_tensor_type,
+         "print_tensor_shape": print_tensor_shape,
+         "print_phase": print_phase, "var_name": input.name})
+    return out
+
+
+def _tensor_report(tag, name, arr, attrs):
+    parts = [attrs.get("message") or ""]
+    if attrs.get("print_tensor_name", True):
+        parts.append("%s %s" % (tag, name))
+    if attrs.get("print_tensor_type", True):
+        parts.append("dtype: %s" % arr.dtype)
+    if attrs.get("print_tensor_shape", True):
+        parts.append("shape: %s" % (tuple(arr.shape),))
+    n = attrs.get("summarize", -1)
+    flat = np.asarray(arr).reshape(-1)
+    if n is not None and n >= 0:
+        flat = flat[:n]
+    parts.append("data: %s" % np.array2string(flat, threshold=20))
+    print("  ".join(p for p in parts if p))
+
+
+@register("print")
+def _print_op(env, op):
+    x = get(env, op.input("In"))
+    attrs = op.attrs
+    name = attrs.get("var_name", "?")
+    first_n = attrs.get("first_n", -1)
+    phase = attrs.get("print_phase", "both")
+    counter = attrs.setdefault("_host_counter", [0])
+    # the autodiff replays forward ops (control_ops loss_fn): forward
+    # prints are suppressed there — the outer pass prints fwd, the replay
+    # (where gradients actually flow) prints bwd
+    in_replay = bool(env.get(REPLAY_KEY))
+
+    def host_print(tag, arr):
+        counter[0] += 1
+        if first_n is None or first_n < 0 or counter[0] <= first_n:
+            _tensor_report(tag, name, arr, attrs)
+
+    def fwd_print(arr):
+        io_callback(lambda a: host_print("fwd", a), None, arr,
+                    ordered=True)
+        return arr
+
+    want_fwd = phase in ("forward", "both") and not in_replay
+    want_bwd = phase in ("backward", "both") and in_replay
+
+    if want_bwd:
+        @jax.custom_vjp
+        def ident(a):
+            return a
+
+        def ident_fwd(a):
+            return a, None
+
+        def ident_bwd(_, g):
+            io_callback(lambda a: host_print("bwd-grad", a), None, g,
+                        ordered=True)
+            return (g,)
+
+        ident.defvjp(ident_fwd, ident_bwd)
+        put(env, op.output("Out"), ident(x))
+    elif want_fwd:
+        put(env, op.output("Out"), fwd_print(x))
+    else:
+        put(env, op.output("Out"), x)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a user Python function as an op (ref ``py_func_op.cc``).
+
+    ``x``: input Variable or list; ``out``: pre-created output Variable
+    or list (shapes/dtypes declare the callback's result signature);
+    ``backward_func(*xs, *outs, *douts) -> dxs`` supplies gradients.
+    """
+    xs = list(x) if isinstance(x, (list, tuple)) else [x]
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    helper = LayerHelper("py_func", name=None)
+    helper.append_op(
+        "py_func", {"X": xs}, {"Out": outs},
+        {"func": func, "backward_func": backward_func})
+    return out
+
+
+@register("py_func")
+def _py_func_op(env, op):
+    xs = [get(env, v) for v in op.input_list("X")]
+    out_vars = op.output_list("Out")
+    func = op.attr("func")
+    backward_func = op.attr("backward_func")
+
+    def out_specs(batch):
+        specs = []
+        for v in out_vars:
+            shape = tuple(batch if s == -1 else s for s in v.shape)
+            specs.append(jax.ShapeDtypeStruct(shape, v.dtype))
+        return tuple(specs)
+
+    batch = xs[0].shape[0] if xs and xs[0].ndim else 1
+    specs = out_specs(batch)
+
+    def call_fwd(*args):
+        res = func(*[np.asarray(a) for a in args])
+        res = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(np.asarray(r, dtype=s.dtype).reshape(s.shape)
+                     for r, s in zip(res, specs))
+
+    if backward_func is None:
+        outs = jax.pure_callback(call_fwd, specs, *xs)
+    else:
+        @jax.custom_vjp
+        def pf(*xs_):
+            return jax.pure_callback(call_fwd, specs, *xs_)
+
+        def pf_fwd(*xs_):
+            outs_ = jax.pure_callback(call_fwd, specs, *xs_)
+            return outs_, (xs_, outs_)
+
+        def pf_bwd(res, gs):
+            xs_, outs_ = res
+            x_specs = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                            for a in xs_)
+
+            def call_bwd(*args):
+                r = backward_func(*[np.asarray(a) for a in args])
+                r = r if isinstance(r, (list, tuple)) else [r]
+                return tuple(np.asarray(v, dtype=s.dtype).reshape(s.shape)
+                             for v, s in zip(r, x_specs))
+
+            return jax.pure_callback(call_bwd, x_specs,
+                                     *(xs_ + outs_ + tuple(gs)))
+
+        pf.defvjp(pf_fwd, pf_bwd)
+        outs = pf(*xs)
+
+    for v, o in zip(out_vars, outs):
+        put(env, v, o)

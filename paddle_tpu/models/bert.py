@@ -108,4 +108,6 @@ def bert_base(vocab_size=30522, seq_len=128, d_model=768, d_ff=3072,
                "mlm_weights": FeedSpec([seq_len], "float32", 0.0, 1.0),
                "nsp_label": FeedSpec([1], "int64", 0, 2)},
         flops_per_example=2 * 3 * total_mac * seq_len,
-        tokens_per_example=seq_len)
+        tokens_per_example=seq_len,
+        sequence_feeds=["input_ids", "segment_ids", "mlm_labels",
+                        "mlm_weights"])

@@ -251,10 +251,15 @@ def bert_base_dygraph(vocab_size=30522, seq_len=128, d_model=768,
     return model, feeds, 2 * 3 * total_mac * seq_len, seq_len
 
 
-def make_train_step(model, learning_rate=1e-4, b1=0.9, b2=0.999, eps=1e-8):
-    """jit-ready Adam train step over the functional export:
+def make_train_step(model, learning_rate=1e-4, b1=0.9, b2=0.999, eps=1e-8,
+                    optimizer="adam", weight_decay=0.01):
+    """jit-ready train step over the functional export:
     ``step(params, opt_state, key, *feeds) -> (loss, params', opt_state')``.
-    The dygraph -> XLA path: one compiled step, donated state."""
+    The dygraph -> XLA path: one compiled step, donated state.
+    ``optimizer``: "adam" or "lamb" (the BERT-pretraining recipe —
+    same rule as the static ``lamb`` kernel, optimizer_ops.py:_lamb)."""
+    if optimizer not in ("adam", "lamb"):
+        raise ValueError("unknown optimizer %r (adam|lamb)" % optimizer)
     apply_fn, params0 = model.functional(rng=True)
 
     def loss_fn(params, key, *feeds):
@@ -269,15 +274,27 @@ def make_train_step(model, learning_rate=1e-4, b1=0.9, b2=0.999, eps=1e-8):
     def step(params, opt_state, key, *feeds):
         loss, grads = jax.value_and_grad(loss_fn)(params, key, *feeds)
         t = opt_state["t"] + 1
-        lr_t = learning_rate * jnp.sqrt(1 - b2 ** t.astype(jnp.float32)) \
-            / (1 - b1 ** t.astype(jnp.float32))
+        tf = t.astype(jnp.float32)
         m = jax.tree_util.tree_map(
             lambda mm, g: b1 * mm + (1 - b1) * g, opt_state["m"], grads)
         v = jax.tree_util.tree_map(
             lambda vv, g: b2 * vv + (1 - b2) * g * g, opt_state["v"], grads)
-        new_params = jax.tree_util.tree_map(
-            lambda p, mm, vv: p - lr_t * mm / (jnp.sqrt(vv) + eps),
-            params, m, v)
+        if optimizer == "lamb":
+            def upd(p, mm, vv):
+                m_hat = mm / (1 - b1 ** tf)
+                v_hat = vv / (1 - b2 ** tf)
+                r = m_hat / (jnp.sqrt(v_hat) + eps) + weight_decay * p
+                p_norm = jnp.sqrt(jnp.sum(jnp.square(p)))
+                r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+                trust = jnp.where((p_norm > 0) & (r_norm > 0),
+                                  p_norm / r_norm, 1.0)
+                return p - learning_rate * trust * r
+        else:
+            lr_t = learning_rate * jnp.sqrt(1 - b2 ** tf) / (1 - b1 ** tf)
+
+            def upd(p, mm, vv):
+                return p - lr_t * mm / (jnp.sqrt(vv) + eps)
+        new_params = jax.tree_util.tree_map(upd, params, m, v)
         return loss, new_params, {"m": m, "v": v, "t": t}
 
     return step, params0, opt0

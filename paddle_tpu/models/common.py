@@ -39,17 +39,25 @@ class ModelSpec:
         row touches); None if not computed. Basis for roofline-style
         vs_baseline where MFU is meaningless (bench.py deepfm).
       tokens_per_example: for sequence models, tokens per example.
+      sequence_feeds: feed names whose dim 1 is the sequence axis —
+        callers pass these to ``with_data_parallel(sequence_feeds=...)``
+        for sequence-parallel sharding (explicit beats the executor's
+        opt-in heuristic). None (the default for specs not yet
+        annotated) keeps with_data_parallel's own default behavior
+        rather than silently pinning feeds to dp-only.
     """
 
     def __init__(self, loss, feeds, fetches=None, flops_per_example=None,
                  tokens_per_example=None, extras=None,
-                 bytes_per_example=None):
+                 bytes_per_example=None, sequence_feeds=None):
         self.loss = loss
         self.feeds = feeds
         self.fetches = dict(fetches or {})
         self.flops_per_example = flops_per_example
         self.bytes_per_example = bytes_per_example
         self.tokens_per_example = tokens_per_example
+        self.sequence_feeds = (list(sequence_feeds)
+                               if sequence_feeds is not None else None)
         # named internal vars (e.g. pipeline cut points, block outputs)
         self.extras = dict(extras or {})
 

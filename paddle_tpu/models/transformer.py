@@ -139,6 +139,7 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
             src_vocab, trg_vocab, seq_len, d_model, d_ff, n_head,
             n_layer) * seq_len,
         tokens_per_example=seq_len,
+        sequence_feeds=["src_ids", "trg_ids", "lbl_ids"],
         extras={"enc_out": enc.name, "block_outs": block_outs})
 
 

@@ -136,3 +136,81 @@ def test_backward_uses_forward_time_values():
         w._value = jnp.asarray(np.array([10.0], "f4"))  # optimizer step
         loss.backward()
         np.testing.assert_allclose(w.gradient(), [4.0])
+
+
+@pytest.mark.parametrize("make_opt", [
+    lambda ps: dygraph.MomentumOptimizer(0.05, 0.9, parameter_list=ps),
+    lambda ps: dygraph.MomentumOptimizer(0.05, 0.9, use_nesterov=True,
+                                         parameter_list=ps),
+    lambda ps: dygraph.AdagradOptimizer(0.2, parameter_list=ps),
+    lambda ps: dygraph.LambOptimizer(0.05, parameter_list=ps),
+], ids=["momentum", "nesterov", "adagrad", "lamb"])
+def test_dygraph_optimizer_family_trains(rng, make_opt):
+    """Static-parity optimizer set in dygraph (VERDICT r4 #8): each rule
+    drives the imperative MLP loss down like its static kernel."""
+    xs = rng.randn(16, 8).astype("f4")
+    ys = xs @ rng.randn(8, 1).astype("f4")
+
+    with dygraph.guard():
+        fc1 = dnn.FC(size=16, act="relu")
+        fc2 = dnn.FC(size=1)
+        losses = []
+        opt = None
+        for step in range(30):
+            pred = fc2(fc1(dygraph.to_variable(xs)))
+            diff = pred - dygraph.to_variable(ys)
+            loss = (diff * diff).mean()
+            if opt is None:
+                opt = make_opt(fc1.parameters() + fc2.parameters())
+            loss.backward()
+            opt.minimize(loss)
+            losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_dygraph_weight_decay_shrinks_params(rng):
+    """regularization=L2Decay folds coeff*p into the grad (the static
+    append_regularization_ops analog)."""
+    import paddle_tpu as fluid
+
+    with dygraph.guard():
+        fc = dnn.FC(size=4)
+        x = dygraph.to_variable(np.zeros((2, 4), "f4"))
+        (fc(x) * 0.0).mean().backward()  # zero grads, params materialized
+        params = fc.parameters()
+        before = [np.asarray(p._value).copy() for p in params]
+        opt = dygraph.SGDOptimizer(
+            0.5, parameter_list=params,
+            regularization=fluid.regularizer.L2Decay(0.1))
+        loss = (fc(x) * 0.0).mean()
+        loss.backward()
+        opt.minimize(loss)
+        after = [np.asarray(p._value) for p in params]
+    for b, a in zip(before, after):
+        if b.size and np.abs(b).max() > 0:
+            np.testing.assert_allclose(a, b * (1 - 0.5 * 0.1), rtol=1e-5)
+
+
+def test_dygraph_bert_lamb_step(rng):
+    """The BERT-dygraph bench route runs under LAMB (tiny shapes)."""
+    import jax
+    import paddle_tpu as fluid
+    from paddle_tpu.models import bert_dygraph
+
+    model, feed_names, flops, toks = bert_dygraph.bert_base_dygraph(
+        vocab_size=64, seq_len=8, d_model=16, d_ff=32, n_layer=1,
+        n_head=2, amp=False)
+    feeds = bert_dygraph.sample_batch(2, 8, 64, np.random.RandomState(0))
+    with fluid.dygraph.guard():
+        model(*feeds)
+    step, params, opt_state = bert_dygraph.make_train_step(
+        model, learning_rate=1e-3, optimizer="lamb")
+    jstep = jax.jit(step)
+    key = jax.random.PRNGKey(0)
+    l0 = None
+    for i in range(4):
+        key, sub = jax.random.split(key)
+        loss, params, opt_state = jstep(params, opt_state, sub, *feeds)
+        l0 = float(loss) if l0 is None else l0
+    assert np.isfinite(float(loss))
+    assert float(loss) < l0  # lamb steps reduce the synthetic loss

@@ -74,3 +74,27 @@ def test_predictor_combined_file_config(tmp_path):
         Predictor(AnalysisConfig(
             model_dir=str(tmp_path),
             prog_file=str(tmp_path / "model" / "__model__")))
+
+
+def test_stablehlo_artifact_round_trip(tmp_path):
+    """Serve from the serialized StableHLO artifact ALONE (no program
+    replay) and match the program-path predictor exactly — ref parity:
+    CreatePaddlePredictor runs from the serialized model
+    (analysis_predictor.cc:734). The export's symbolic batch dim must
+    accept a batch size never seen at export time."""
+    import os
+
+    from paddle_tpu.inference import load_stablehlo_predictor
+
+    xs, want = _train_and_save(tmp_path)
+    d = str(tmp_path / "model")
+    assert os.path.exists(os.path.join(d, "model.stablehlo.bin"))
+    pred = load_stablehlo_predictor(d)
+    assert pred.get_input_names() == ["x"]
+    got, = pred.run({"x": xs})
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    if pred.batch_mode == "symbolic":
+        big = np.tile(xs, (3, 1))  # batch 6 vs export-time placeholder
+        got6, = pred.run([big])
+        np.testing.assert_allclose(got6, np.tile(want, (3, 1)),
+                                   rtol=1e-5, atol=1e-6)

@@ -64,7 +64,8 @@ def test_dp_mp_transformer_converges():
         exe = fluid.Executor(fluid.CPUPlace())
         exe.run(startup)
         cp = fluid.CompiledProgram(main).with_data_parallel(
-            loss_name=spec.loss.name, mesh=mesh, sp_axis="sp")
+            loss_name=spec.loss.name, mesh=mesh, sp_axis="sp",
+            sequence_feeds=spec.sequence_feeds)
         batch = spec.sample_batch(4, np.random.RandomState(2))
         first = last = None
         for _ in range(6):
@@ -443,7 +444,7 @@ def test_compiled_hlo_sharding_quality():
         exe.run(startup)
         cp = fluid.CompiledProgram(main).with_data_parallel(
             loss_name=spec.loss.name, mesh=mesh, dp_axis="dp",
-            sp_axis="sp")
+            sp_axis="sp", sequence_feeds=spec.sequence_feeds)
         feed = spec.sample_batch(4, np.random.RandomState(0))
         lv, = exe.run(cp, feed=feed, fetch_list=[spec.loss])
         hlo = exe.lowered_hlo_text()
@@ -462,3 +463,57 @@ def test_compiled_hlo_sharding_quality():
     assert ag, "expected >=2-D activation all-gathers under mp/sp sharding"
     with pytest.raises(AssertionError):
         sharding_check.assert_no_param_allgather(hlo, [ag[0]])
+
+
+def test_pipeline_sparse_embedding_matches_single_device():
+    """An ``is_sparse`` embedding trains correctly under pipeline
+    parallelism: the table grad densifies through the GPipe scan (rows =
+    arange contract — control_ops pp branch) and the loss/weight
+    trajectory matches the single-device run."""
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 30, size=(8, 1)).astype("int64")
+    ys = rng.randn(8, 1).astype("float32")
+
+    def run(pipeline):
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 21
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            fluid.unique_name.switch()
+            x = fluid.layers.data("ids", shape=[1], dtype="int64")
+            y = fluid.layers.data("y", shape=[1])
+            emb = fluid.layers.embedding(x, size=[30, 16], is_sparse=True)
+            h = emb
+            cuts = []
+            for i in range(4):
+                h = fluid.layers.fc(h, size=16, act="tanh",
+                                    name="sblk%d" % i)
+                if i < 3:
+                    cuts.append(h.name)
+            pred = fluid.layers.fc(h, size=1, name="shead")
+            loss = fluid.layers.mean(
+                fluid.layers.square_error_cost(pred, y))
+            pg = fluid.optimizer.Adam(learning_rate=0.01).minimize(loss)[1]
+            table = main.all_parameters()[0]
+            (p, g), = [t for t in pg if t[0].name == table.name]
+            assert getattr(g, "sparse_rows_var", None) is not None
+            exe = fluid.Executor(fluid.CPUPlace())
+            exe.run(startup)
+            prog = main
+            if pipeline:
+                mesh = _mesh((4,), ("pp",))
+                prog = fluid.CompiledProgram(main).with_pipeline(
+                    loss_name=loss.name, mesh=mesh, boundaries=cuts,
+                    n_microbatches=4)
+            losses = []
+            for _ in range(4):
+                lv, = exe.run(prog, feed={"ids": ids, "y": ys},
+                              fetch_list=[loss])
+                losses.append(float(lv))
+            w = scope.numpy(table.name)
+        return losses, w
+
+    ref_losses, ref_w = run(pipeline=False)
+    pp_losses, pp_w = run(pipeline=True)
+    np.testing.assert_allclose(pp_losses, ref_losses, rtol=2e-4, atol=1e-5)
+    np.testing.assert_allclose(pp_w, ref_w, rtol=2e-4, atol=1e-5)
