@@ -9,9 +9,12 @@ seq2048|all``; ``--dygraph`` routes bert through the dygraph build).
 Each line: {"metric", "value", "unit", "vs_baseline"}. ``vs_baseline``
 is model FLOPs utilization (MFU) relative to the BASELINE.json
 north-star target of 45% MFU (>1.0 beats the target); for the
-bandwidth-bound DeepFM config it is throughput vs 45% of the
-roofline-implied examples/sec (max of compute and HBM-traffic floors —
-MFU is meaningless for a gather-dominated model). Measurement follows
+row-latency-bound DeepFM config it is throughput vs 45% of the
+roofline-implied examples/sec, where the floor sums MLP MXU time with
+the measured per-row gather/scatter latencies (models/deepfm.py; MFU
+and bandwidth are both meaningless for a gather-dominated model — note
+the CPU smoke run's vs_baseline uses the same TPU-measured row
+latencies and is not comparable to pre-r5 records). Measurement follows
 the reference convention of examples/sec per model
 (``benchmark/fluid/fluid_benchmark.py:297``), expressed per-token for
 the sequence models.
@@ -45,14 +48,6 @@ def _peak_flops(device):
     if device.platform == "cpu":
         return 1e11  # nominal, for smoke runs
     return 197e12  # assume v5e-class if unrecognized
-
-
-def _peak_hbm_gbs(device):
-    """Measured-class HBM stream bandwidth (CHIP_CEILING.json: 552 GB/s
-    on the benched v5e; 819 nominal). Used only for the DeepFM roofline."""
-    if device.platform == "cpu":
-        return 10e9
-    return 552e9
 
 
 def _build(model, on_tpu, seq_override=None):
@@ -152,10 +147,13 @@ def _bench_static(model, on_tpu, seq_override=None):
     examples_per_sec = batch * per_example * steps / dt
     dev = jax.devices()[0]
     if model == "deepfm":
-        # roofline basis: per-example floor = max(compute, HBM traffic)
-        floor_s = max((spec.flops_per_example or 0) / _peak_flops(dev),
-                      (getattr(spec, "bytes_per_example", 0) or 0)
-                      / _peak_hbm_gbs(dev))
+        # roofline basis: embedding-bound CTR is per-ROW-LATENCY-bound on
+        # TPU, so the floor sums the MLP's MXU time with the measured
+        # per-row gather/scatter latencies (models/deepfm.py documents
+        # the constants; tools/bench_gather.py measures them — chip
+        # properties like the measured HBM stream rate)
+        floor_s = ((spec.flops_per_example or 0) / _peak_flops(dev)
+                   + spec.extras["row_latency_s_per_example"])
         target = 0.45 / max(floor_s, 1e-30)   # 45% of roofline examples/s
         vsb = (examples_per_sec / per_example) / target
     else:

@@ -22,8 +22,14 @@ __all__ = ["AnalysisConfig", "Predictor", "create_paddle_predictor",
 
 
 class AnalysisConfig:
-    """Parity shim for the reference AnalysisConfig. Device/IR knobs that
-    have no TPU meaning are recorded but inert (XLA owns optimization)."""
+    """Parity shim for the reference AnalysisConfig.
+
+    INERT KNOBS — read this before tuning: ``enable_use_gpu``,
+    ``disable_gpu``, ``switch_ir_optim`` and ``enable_memory_optim`` are
+    recorded but change NOTHING on TPU. The reference's analysis passes
+    (IR fusion, TensorRT/MKLDNN subgraphs, memory reuse) are subsumed by
+    XLA compiling the whole pruned program; execution always targets the
+    XLA default device. Only the model paths act."""
 
     def __init__(self, model_dir=None, prog_file=None, params_file=None):
         self.model_dir = model_dir

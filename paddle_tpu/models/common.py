@@ -33,11 +33,9 @@ class ModelSpec:
       feeds: ordered dict name -> FeedSpec (synthetic-data recipe).
       fetches: extra fetch Variables by name (e.g. accuracy).
       flops_per_example: analytic fwd+bwd FLOPs per example (for MFU calc);
-        None if not computed.
-      bytes_per_example: analytic HBM traffic per example for
-        bandwidth-bound models (embedding gather/scatter + sparse-opt
-        row touches); None if not computed. Basis for roofline-style
-        vs_baseline where MFU is meaningless (bench.py deepfm).
+        None if not computed. Row-latency-bound models (deepfm) put
+        their roofline basis in extras["row_latency_s_per_example"]
+        instead (bench.py reads it).
       tokens_per_example: for sequence models, tokens per example.
       sequence_feeds: feed names whose dim 1 is the sequence axis —
         callers pass these to ``with_data_parallel(sequence_feeds=...)``
@@ -49,12 +47,11 @@ class ModelSpec:
 
     def __init__(self, loss, feeds, fetches=None, flops_per_example=None,
                  tokens_per_example=None, extras=None,
-                 bytes_per_example=None, sequence_feeds=None):
+                 sequence_feeds=None):
         self.loss = loss
         self.feeds = feeds
         self.fetches = dict(fetches or {})
         self.flops_per_example = flops_per_example
-        self.bytes_per_example = bytes_per_example
         self.tokens_per_example = tokens_per_example
         self.sequence_feeds = (list(sequence_feeds)
                                if sequence_feeds is not None else None)

@@ -410,21 +410,27 @@ def _pad_vec(x, m):
     return jnp.pad(x, ((0, 0), (0, r))) if r else x
 
 
-def _block_sizes(t, t_k):
+def _block_sizes(t, t_k, bwd=False):
     """Mosaic wants the lane (last) dim of 1-D stats blocks divisible by
     128, so real-TPU blocks are 128-multiples; interpret mode uses
     8-multiples to exercise the padded-edge logic cheaply.
-    PADDLE_TPU_FLASH_BLOCK overrides the default cap (A/B knob). 512 is
-    the measured sweet spot at T=2048 (tools/attn_device_time.py: fwd
-    4.46 -> 2.18 ms vs 256-blocks, bwd 8.76 -> 5.86; 128 is 2.5x worse,
-    1024 regresses bwd) — bigger blocks amortize the per-iteration
-    MXU/VPU serialization across 4x the elements."""
+    PADDLE_TPU_FLASH_BLOCK (and _BWD for the backward kernels) override
+    the default caps (A/B knobs). NOTE: the _BWD override only engages
+    when dropout is OFF — dropout masks regenerate per (bh, q-block,
+    k-block) tile, so fwd and bwd must share block geometry. 512 is the
+    measured sweet spot at T=2048 for BOTH directions
+    (tools/attn_device_time.py: fwd 4.46 -> 2.18 ms vs 256-blocks, bwd
+    8.76 -> 5.86; 128 is 2.5x worse, 1024 regresses bwd) — bigger
+    blocks amortize the per-iteration MXU/VPU serialization across 4x
+    the elements."""
     m = 8 if _INTERPRET else 128
     default = 64 if _INTERPRET else 512   # small interpret cap keeps the
     try:                                  # multi-block paths exercised
         cap = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK", default))
+        if bwd:
+            cap = int(os.environ.get("PADDLE_TPU_FLASH_BLOCK_BWD", cap))
     except ValueError:
-        raise ValueError("PADDLE_TPU_FLASH_BLOCK must be an integer")
+        raise ValueError("PADDLE_TPU_FLASH_BLOCK(_BWD) must be integers")
 
     def r(x):
         return ((x + m - 1) // m) * m
@@ -494,7 +500,10 @@ def _flash_bwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate,
 
     bh, t, d = q.shape
     t_k = k.shape[1]
-    block_q, block_k = _block_sizes(t, t_k)
+    # dropout masks regenerate per (bh, q-block, k-block) tile: the bwd
+    # may only use different block sizes than fwd when dropout is off
+    block_q, block_k = _block_sizes(t, t_k,
+                                    bwd=(dropout_rate == 0.0))
     qp, kp, vp = _pad_t(q, block_q), _pad_t(k, block_k), _pad_t(v, block_k)
     dop = _pad_t(do, block_q)
     t_pad, tk_pad = qp.shape[1], kp.shape[1]

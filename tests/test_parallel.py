@@ -113,7 +113,7 @@ def test_distribute_transpiler_annotates():
                 strategy=DistStrategy(dp=4, mp=2, sharded_embeddings=True))
     trainer_prog = t.get_trainer_program()
     assert trainer_prog is not None
-    emb = main._params.get("fm_emb")
+    emb = main._params.get("fm_table")
     assert emb is not None and emb.sharding is not None
     # lookups on sharded tables route through the shard_map pserver-analog
     assert any(o.type == "sharded_lookup_table"
