@@ -14,6 +14,8 @@ splitting it; both get_*_program return the same annotated program so
 reference-style launch scripts run unchanged on every host (SPMD).
 """
 
+import warnings
+
 from ..core import framework
 from .mesh import DistStrategy, set_mesh
 
@@ -76,10 +78,20 @@ class DistributeTranspiler:
                         op.type = "sharded_lookup_table"
                         op.attrs["mesh_axis"] = axis
         if not sync_mode:
-            # async SGD has no XLA analog; document sync-equivalent behavior
-            # (ref SURVEY.md §7 hard parts) — convergence parity, not step
-            # parity.
-            pass
+            # The reference's async-SGD/pserver modes (pslib/Downpour,
+            # DC-ASGD — ref async_executor.cc:72, downpour.py:24,
+            # distribute_transpiler.py:154) have no XLA analog: SPMD
+            # steps are synchronous by construction. Per SURVEY §7 the
+            # framework substitutes SYNC-EQUIVALENT training — same
+            # sharded-table placement, synchronous updates — whose
+            # convergence parity vs single-chip is asserted by
+            # tests/test_parallel.py::test_sharded_deepfm_convergence_parity.
+            # Loud, once, so nobody assumes staleness-tolerant semantics:
+            warnings.warn(
+                "sync_mode=False: async/pserver semantics run as their "
+                "synchronous equivalent on TPU (convergence-parity "
+                "tested); there is no staleness/delay-compensation here",
+                RuntimeWarning, stacklevel=2)
         return self
 
     def get_trainer_program(self, wait_port=True):
