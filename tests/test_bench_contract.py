@@ -1,0 +1,44 @@
+"""bench.py contract guards: every BASELINE config _build()s with the
+fields the bench math needs (flops for MFU configs, the row-latency
+roofline key for deepfm), and metric names stay unique per config."""
+
+import paddle_tpu as fluid
+
+
+def _specs(monkeypatch):
+    import bench
+
+    monkeypatch.delenv("BENCH_SEQ", raising=False)
+    out = {}
+    for model in ("transformer", "bert", "resnet50", "deepfm"):
+        main, startup = fluid.Program(), fluid.Program()
+        with fluid.program_guard(main, startup):
+            fluid.unique_name.switch()
+            spec, batch, metric, unit, per_example = bench._build(
+                model, on_tpu=False)
+        out[model] = (spec, batch, metric, unit, per_example)
+    return out
+
+
+def test_build_contract(monkeypatch):
+    specs = _specs(monkeypatch)
+    metrics = [v[2] for v in specs.values()]
+    assert len(set(metrics)) == len(metrics), metrics
+    for model, (spec, batch, metric, unit, per_example) in specs.items():
+        assert batch > 0 and per_example
+        assert spec.flops_per_example and spec.flops_per_example > 0, model
+    # deepfm's vs_baseline basis reads this key (bench.py _bench_static)
+    assert "row_latency_s_per_example" in specs["deepfm"][0].extras
+    assert specs["deepfm"][0].extras["row_latency_s_per_example"] > 0
+
+
+def test_seq_override_metric_suffix(monkeypatch):
+    import bench
+
+    monkeypatch.delenv("BENCH_SEQ", raising=False)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.unique_name.switch()
+        _, _, metric, _, _ = bench._build("transformer", on_tpu=False,
+                                          seq_override=128)
+    assert metric == "transformer_base_seq128_tokens_per_sec_per_chip"
