@@ -1,10 +1,12 @@
 """Benchmark: training throughput on one chip for ALL BASELINE configs.
 
 Default (driver-run): every BASELINE config, one JSON line each —
-deepfm, long-context (seq-2048), resnet50, bert-dygraph, bert, and
+serving (requests/sec at fixed p99 through paddle_tpu.serving), deepfm,
+long-context (seq-2048), resnet50, bert-dygraph, bert, and
 transformer-base last (the flagship). Select a single config with
 ``--model`` / ``BENCH_MODEL`` (``transformer|bert|resnet50|deepfm|
-seq2048|all``; ``--dygraph`` routes bert through the dygraph build).
+seq2048|serving|all``; ``--dygraph`` routes bert through the dygraph
+build).
 
 Each line: {"metric", "value", "unit", "vs_baseline"}. ``vs_baseline``
 is model FLOPs utilization (MFU) relative to the BASELINE.json
@@ -164,6 +166,80 @@ def _bench_static(model, on_tpu, seq_override=None):
             "unit": unit, "vs_baseline": round(vsb, 4)}
 
 
+def _bench_serving(on_tpu):
+    """Serving throughput through ``paddle_tpu.serving.ServingEngine``:
+    requests/sec sustained by concurrent clients against a replica pool
+    with dynamic micro-batching on a pow2 bucket ladder. ``vs_baseline``
+    is the p99 latency budget over the measured p99 (>= 1.0 means the
+    tail met the budget: 10 ms on TPU, 75 ms for the CPU smoke run) —
+    i.e. requests/sec *at fixed p99*, the serving-side counterpart of
+    the training configs' MFU ratio. Knobs: BENCH_SERVING_REQUESTS,
+    BENCH_SERVING_CLIENTS, BENCH_SERVING_REPLICAS."""
+    import shutil
+    import tempfile
+    import threading
+
+    import paddle_tpu as fluid
+    from paddle_tpu import serving
+
+    requests = int(os.environ.get("BENCH_SERVING_REQUESTS",
+                                  2000 if on_tpu else 300))
+    clients = int(os.environ.get("BENCH_SERVING_CLIENTS", 4))
+    replicas = int(os.environ.get("BENCH_SERVING_REPLICAS", 2))
+    p99_budget_s = 0.010 if on_tpu else 0.075
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        x = fluid.layers.data("x", shape=[64])
+        h = fluid.layers.fc(x, size=256, act="relu")
+        prob = fluid.layers.softmax(fluid.layers.fc(h, size=16))
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        model_dir = tempfile.mkdtemp(prefix="bench_serving_")
+        fluid.io.save_inference_model(model_dir, ["x"], [prob], exe,
+                                      main_program=main)
+
+    eng = serving.ServingEngine(model_dir, num_replicas=replicas,
+                                max_batch_size=8, max_wait_ms=2,
+                                max_queue_depth=max(64, 4 * clients))
+    try:
+        eng.warmup()
+        rng = np.random.RandomState(0)
+        batches = [rng.randn(1, 64).astype("f4") for _ in range(32)]
+        done = threading.Semaphore(0)
+        per_client = requests // clients
+
+        def client(cid):
+            try:
+                for i in range(per_client):
+                    try:
+                        eng.submit(
+                            {"x": batches[(cid + i) % 32]}).result(30.0)
+                    except serving.ServerOverloadedError:
+                        time.sleep(0.002)
+            finally:
+                done.release()  # a failed client must not hang the bench
+
+        t0 = time.perf_counter()
+        for cid in range(clients):
+            threading.Thread(target=client, args=(cid,),
+                             daemon=True).start()
+        for _ in range(clients):
+            done.acquire()
+        dt = time.perf_counter() - t0
+        m = eng.metrics()
+    finally:
+        eng.shutdown(drain=True)
+        shutil.rmtree(model_dir, ignore_errors=True)
+    rps = m["requests_completed"] / dt
+    p99 = m["latency_s"]["p99"] or float("inf")
+    return {"metric": "serving_requests_per_sec", "value": round(rps, 1),
+            "unit": "requests/sec",
+            "vs_baseline": round(p99_budget_s / p99, 4)}
+
+
 def _bench_bert_dygraph(on_tpu):
     """BASELINE config 4 as written: BERT through the DYGRAPH build,
     functional export -> one jitted train step (models/bert_dygraph.py)."""
@@ -216,7 +292,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default=os.environ.get("BENCH_MODEL", "all"),
                     choices=["all", "transformer", "bert", "resnet50",
-                             "deepfm", "seq2048"])
+                             "deepfm", "seq2048", "serving"])
     ap.add_argument("--dygraph", action="store_true",
                     default=os.environ.get("BENCH_DYGRAPH", "") == "1",
                     help="route bert through the dygraph build")
@@ -234,9 +310,18 @@ def main():
     def emit(rec):
         print(json.dumps(rec), flush=True)
 
+    if args.model == "serving":
+        return emit(_bench_serving(on_tpu))
+
     if args.model == "all":
-        # full BASELINE matrix; transformer (the flagship) prints LAST so
-        # single-line consumers of the output still see the headline row
+        # full BASELINE matrix + the serving tier; transformer (the
+        # flagship) prints LAST so single-line consumers of the output
+        # still see the headline row
+        try:
+            emit(_bench_serving(on_tpu))
+        except Exception as e:  # never abort the BASELINE matrix
+            import sys
+            print("serving bench failed: %r" % (e,), file=sys.stderr)
         emit(_bench_static("deepfm", on_tpu))
         emit(_bench_static("transformer", on_tpu,
                            seq_override=2048 if on_tpu else 128))

@@ -42,6 +42,7 @@ from . import amp  # noqa: F401
 from . import io  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import inference  # noqa: F401
+from . import serving  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401
 from . import contrib  # noqa: F401
 from .data.data_feed import DataFeedDesc  # noqa: F401

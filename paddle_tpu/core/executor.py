@@ -163,19 +163,28 @@ def _make_rng_key(seed):
 
 
 def build_step_fn(program, fetch_names, persist_names, pp_cfg=None,
-                  fuse_opt=True, grad_scale=None):
+                  fuse_opt=True, grad_scale=None, infer_only=False):
     """Trace a program's global block into one pure function
     ``(state, feed, rng) -> (fetches, new_state, rng')`` — the unit the
     Executor jits, ``__graft_entry__`` exposes, and bench.py times.
     ``pp_cfg`` routes the autodiff replay through the pipeline engine
     (see ``parallel/pipeline.py``). ``fuse_opt`` batches dense optimizer
     updates into one flattened kernel (see ``opt_fusion.py``); the mesh
-    path disables it to keep per-tensor GSPMD sharding propagation."""
+    path disables it to keep per-tensor GSPMD sharding propagation.
+    ``infer_only`` narrows ``new_state`` to persistables some op actually
+    writes: an inference program then returns NO state, so running it
+    without donation (see ``Executor.run(donate_state=False)``) neither
+    invalidates nor copies the shared weights."""
     from .op_registry import env_flag
     from .opt_fusion import plan_opt_fusion, run_fused_group
 
     ops = list(program.global_block().ops)
     persist_set = set(persist_names)
+    if infer_only:
+        produced = set()
+        for op in ops:
+            produced.update(op.output_arg_names)
+        persist_set &= produced
     amp = bool(getattr(program, "_amp_bf16", False))
     # measured on-chip (NOTES_r3.md): per-param updates cost ~8us each in
     # isolation — the profile's ~100us/update is scheduling stall, which
@@ -249,7 +258,13 @@ class Executor:
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, feed_var_name="feed",
-            fetch_var_name="fetch", check_nan_inf=None):
+            fetch_var_name="fetch", check_nan_inf=None, donate_state=True):
+        """``donate_state=False`` compiles the step WITHOUT donating the
+        state pytree (and, off-mesh, without echoing unwritten state back
+        out). Donation invalidates the input weight arrays mid-call — fine
+        for a single-threaded training loop that re-sets the scope right
+        after, but a use-after-free race when predictor clones serve the
+        same scope from concurrent threads (``inference.py``/``serving``)."""
         from .compiler import CompiledProgram
 
         if program is None:
@@ -371,13 +386,13 @@ class Executor:
             (n, a.shape, str(a.dtype)) for n, a in feed_arrays.items()))
         key = (id(program), program._version, feed_sig, tuple(fetch_names),
                state_in_names, id(scope), mesh, dp_axis, sp_axis, seq_feeds,
-               pp, zero_state, grad_scale)
+               pp, zero_state, grad_scale, donate_state)
         entry = self._cache.get(key) if use_program_cache else None
         if entry is None:
             entry = self._compile(program, tuple(sorted(feed_arrays)),
                                   fetch_names, state_in_names, persist_names,
                                   mesh, dp_axis, sp_axis, seq_feeds, pp,
-                                  zero_state, grad_scale)
+                                  zero_state, grad_scale, donate_state)
             if use_program_cache:
                 self._cache[key] = entry
         jfn = entry
@@ -600,17 +615,21 @@ class Executor:
 
     def _compile(self, program, feed_names, fetch_names, state_in_names,
                  persist_names, mesh, dp_axis, sp_axis=None, seq_feeds=None,
-                 pp=None, zero_state=False, grad_scale=None):
+                 pp=None, zero_state=False, grad_scale=None,
+                 donate_state=True):
         pp_cfg = None
         if pp is not None:
             pp_axis, pp_boundaries, pp_nmicro = pp
             pp_cfg = {"mesh": mesh, "axis": pp_axis,
                       "boundaries": list(pp_boundaries),
                       "n_micro": pp_nmicro, "feed_names": list(feed_names)}
+        # the infer_only narrowing only applies off-mesh: _mesh_shardings
+        # sizes its out_shardings for the echoed state dict
         step = build_step_fn(program, fetch_names, persist_names,
                              pp_cfg=pp_cfg, fuse_opt=mesh is None,
-                             grad_scale=grad_scale)
-        donate = (0,)
+                             grad_scale=grad_scale,
+                             infer_only=not donate_state and mesh is None)
+        donate = (0,) if donate_state else ()
         extra = _xla_compiler_options()
         if mesh is None:
             return jax.jit(step, donate_argnums=donate, **extra)

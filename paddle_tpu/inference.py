@@ -115,11 +115,14 @@ class Predictor:
                 raise ValueError("missing feeds: %s" % sorted(missing))
         # scope passed explicitly (not via the global scope_guard stack):
         # clones serving concurrently from other threads must not race on
-        # process-global scope resolution
+        # process-global scope resolution. donate_state=False for the same
+        # reason: donation would invalidate the scope's shared weight
+        # arrays mid-call, a use-after-free when another clone reads them
         return self._exe.run(self._program, feed=feed,
                              fetch_list=self._fetch_vars,
                              scope=self._scope,
-                             return_numpy=return_numpy)
+                             return_numpy=return_numpy,
+                             donate_state=False)
 
     predict = run
 
@@ -200,6 +203,22 @@ class StableHLOPredictor:
         return [np.asarray(o) for o in out] if return_numpy else list(out)
 
     predict = run
+
+    def clone(self):
+        """API parity with ``Predictor.clone()`` (ref
+        ``AnalysisPredictor::Clone``) so a replica pool — e.g.
+        ``serving.ServingEngine`` — can treat either predictor type
+        uniformly. The exported computation and the param arrays are
+        immutable, so clones share both; there is no per-clone executor
+        cache to refresh (``jax.export``'s ``call`` compiles per shape
+        internally)."""
+        other = object.__new__(StableHLOPredictor)
+        other._exported = self._exported
+        other._state = self._state
+        other.feed_names = list(self.feed_names)
+        other.fetch_names = list(self.fetch_names)
+        other.batch_mode = self.batch_mode
+        return other
 
     def get_input_names(self):
         return list(self.feed_names)

@@ -5,14 +5,16 @@ TPU-native: jax.profiler XPlane traces (viewable in TensorBoard/Perfetto —
 the chrome-trace parity) + a lightweight host-event aggregator giving the
 reference's sorted-table report."""
 
+import bisect
 import contextlib
+import threading
 import time
-from collections import defaultdict
+from collections import defaultdict, deque
 
 import jax
 
 __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
-           "record_event"]
+           "record_event", "Histogram"]
 
 _events = defaultdict(lambda: [0.0, 0])  # name -> [total_s, count]
 _trace_dir = None
@@ -27,7 +29,10 @@ def start_profiler(state="All", trace_dir=None):
         jax.profiler.start_trace(trace_dir)
 
 
-def stop_profiler(sorted_key="total", profile_path=None):
+def stop_profiler(sorted_key="total", profile_path=None, silent=False):
+    """``silent=True`` returns the report without printing — for callers
+    (e.g. the serving metrics loop) that sample the profiler on a cadence
+    and must not spam stdout."""
     global _enabled
     _enabled = False
     if _trace_dir:
@@ -36,7 +41,7 @@ def stop_profiler(sorted_key="total", profile_path=None):
     if profile_path:
         with open(profile_path, "w") as f:
             f.write(report)
-    else:
+    elif not silent:
         print(report)
     return report
 
@@ -83,3 +88,65 @@ def profiler(state="All", sorted_key="total", profile_path=None,
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+class Histogram:
+    """Thread-safe sliding-window sample store with exact percentiles.
+
+    The host-event table above aggregates to (total, count) — enough for a
+    training report, useless for a latency SLO, where the tail IS the
+    metric. This keeps the most recent ``max_samples`` raw observations
+    (a sliding window, so a long-running server reports *current* tail
+    behavior, not its lifetime average) and computes exact
+    nearest-rank percentiles on demand.
+    """
+
+    def __init__(self, max_samples=8192):
+        self._samples = deque(maxlen=max_samples)
+        self._lock = threading.Lock()
+        self._count = 0
+        self._total = 0.0
+
+    def add(self, value):
+        with self._lock:
+            self._samples.append(float(value))
+            self._count += 1
+            self._total += float(value)
+
+    @property
+    def count(self):
+        """Lifetime observation count (not capped by the window)."""
+        return self._count
+
+    @property
+    def total(self):
+        return self._total
+
+    @staticmethod
+    def _at_rank(data, p):
+        """Nearest-rank percentile over an already-sorted sample list."""
+        if not 0 <= p <= 100:
+            raise ValueError("percentile must be in [0, 100], got %r" % p)
+        rank = max(0, min(len(data) - 1,
+                          int(round(p / 100.0 * (len(data) - 1)))))
+        return data[rank]
+
+    def percentile(self, p):
+        """Nearest-rank percentile of the current window; None if empty."""
+        with self._lock:
+            data = sorted(self._samples)
+        return self._at_rank(data, p) if data else None
+
+    def percentiles(self, ps=(50, 95, 99)):
+        with self._lock:
+            data = sorted(self._samples)
+        return {"p%g" % p: (self._at_rank(data, p) if data else None)
+                for p in ps}
+
+    def cdf(self, value):
+        """Fraction of windowed samples <= value (SLO attainment check)."""
+        with self._lock:
+            data = sorted(self._samples)
+        if not data:
+            return None
+        return bisect.bisect_right(data, value) / float(len(data))

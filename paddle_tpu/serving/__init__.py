@@ -1,0 +1,31 @@
+"""``paddle_tpu.serving`` — in-process dynamic-batching inference serving.
+
+The layer between the model zoo and "heavy traffic from millions of
+users": the reference frames inference as a first-class subsystem
+(``paddle/fluid/inference``, ``AnalysisPredictor::Init/Run/Clone``); this
+package is that subsystem's TPU-native serving tier. See ``engine.py`` for
+the architecture; quickstart:
+
+    from paddle_tpu import serving
+
+    eng = serving.ServingEngine("my/model/dir", num_replicas=2,
+                                max_batch_size=8)
+    eng.warmup()                       # pre-compile every bucket rung
+    fut = eng.submit({"x": one_or_more_rows})
+    prob, = fut.result(timeout=1.0)
+    print(eng.metrics_report())
+    eng.shutdown(drain=True)
+"""
+
+from .admission import (AdmissionController, DeadlineExceededError,  # noqa: F401
+                        ServerOverloadedError)
+from .batcher import DynamicBatcher, Request  # noqa: F401
+from .buckets import (BucketError, bucket_for, pad_to_bucket,  # noqa: F401
+                      pow2_ladder, unpad_fetch)
+from .engine import ServingEngine  # noqa: F401
+from .metrics import ServingMetrics  # noqa: F401
+
+__all__ = ["ServingEngine", "DynamicBatcher", "Request", "ServingMetrics",
+           "AdmissionController", "ServerOverloadedError",
+           "DeadlineExceededError", "BucketError", "pow2_ladder",
+           "bucket_for", "pad_to_bucket", "unpad_fetch"]

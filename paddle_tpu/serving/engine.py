@@ -1,0 +1,334 @@
+"""ServingEngine: the in-process serving layer over the predictor stack.
+
+Reference shape: ``AnalysisPredictor::Init`` loads once, ``Clone()`` hands
+each serving thread a predictor sharing the weights, and the server in
+front batches requests. Here the same three-layer split is TPU-native:
+
+  * load once — one ``Predictor`` (isolated ``Scope`` holding the weights)
+    or one ``StableHLOPredictor`` (immutable exported computation);
+  * replicate — ``clone()`` per worker thread: weights shared, per-worker
+    Executor compile cache (``inference.py`` clone contract), so replicas
+    never contend on a cache dict while XLA releases the GIL during runs;
+  * batch — a ``DynamicBatcher`` cuts size-or-deadline micro-batches,
+    ``buckets.pad_to_bucket`` pads them onto the ladder so every dispatch
+    hits one of at most ``len(ladder)`` compiled executables, and
+    ``warmup()`` pre-compiles every rung before traffic lands.
+
+``submit(feed) -> Future`` is the whole client API; ``shutdown(drain=True)``
+stops intake, serves what's queued, and joins the workers. A worker that
+crashes mid-batch fails only that batch's futures and keeps serving.
+"""
+
+import threading
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..inference import AnalysisConfig, Predictor
+from .admission import (AdmissionController, DeadlineExceededError,
+                        ServerOverloadedError)
+from .batcher import DynamicBatcher, Request
+from .buckets import (bucket_for, edge_pad, pad_to_bucket, pow2_ladder,
+                      unpad_fetch)
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine"]
+
+
+class _Worker:
+    """One replica: a predictor clone plus the shape signatures it has
+    dispatched (the engine-side view of its compile cache, valid for both
+    predictor types)."""
+
+    def __init__(self, predictor):
+        self.predictor = predictor
+        self.seen_signatures = set()
+        self.thread = None
+
+
+class ServingEngine:
+    def __init__(self, model, num_replicas=1, max_batch_size=8,
+                 ladder=None, seq_ladder=None, max_wait_ms=5.0,
+                 max_queue_depth=256, default_timeout_s=None, clock=None,
+                 latency_window=8192):
+        """``model``: a model directory / ``AnalysisConfig`` (loaded via
+        ``Predictor``), or an already-constructed predictor exposing
+        ``run``/``clone``/``feed_names`` (``Predictor`` or
+        ``StableHLOPredictor``)."""
+        if num_replicas < 1:
+            raise ValueError("num_replicas must be >= 1")
+        if isinstance(model, (str, AnalysisConfig)):
+            model = Predictor(model)
+        if not callable(getattr(model, "clone", None)):
+            raise TypeError("model must be a dir/AnalysisConfig or a "
+                            "predictor with clone(); got %r" % (model,))
+        self.ladder = tuple(sorted(set(
+            ladder if ladder is not None else pow2_ladder(max_batch_size))))
+        self.seq_ladder = tuple(seq_ladder) if seq_ladder else None
+        self.max_batch_size = max(self.ladder)
+        self.feed_names = list(getattr(model, "feed_names", []))
+        self.default_timeout_s = default_timeout_s
+
+        self._batcher = DynamicBatcher(self.max_batch_size,
+                                       max_wait_ms=max_wait_ms, clock=clock)
+        self._admission = AdmissionController(max_queue_depth)
+        self.metrics_ = ServingMetrics(latency_window=latency_window)
+        self.metrics_.bind_gauges(self._batcher.depth,
+                                  lambda: self._admission.in_flight)
+
+        self._workers = [_Worker(model)]
+        for _ in range(num_replicas - 1):
+            self._workers.append(_Worker(model.clone()))
+        self._closed = False
+        self._shutdown_done = False
+        for i, w in enumerate(self._workers):
+            w.thread = threading.Thread(
+                target=self._worker_loop, args=(w,),
+                name="paddle-tpu-serve-%d" % i, daemon=True)
+            w.thread.start()
+
+    # -- client surface -----------------------------------------------------
+    def submit(self, feed, timeout_s=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``
+        resolving to the fetch list (arrays sliced to this request's rows).
+
+        Raises :class:`ServerOverloadedError` immediately when the bounded
+        queue is full, ``BucketError`` when the request's batch exceeds the
+        top rung, ``RuntimeError`` after shutdown."""
+        if self._closed:
+            raise RuntimeError("ServingEngine is shut down")
+        if isinstance(feed, (list, tuple)):
+            if len(feed) != len(self.feed_names):
+                raise ValueError("expected %d inputs (%s), got %d"
+                                 % (len(self.feed_names), self.feed_names,
+                                    len(feed)))
+            feed = dict(zip(self.feed_names, feed))
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        if self.feed_names:
+            missing = set(self.feed_names) - set(feed)
+            if missing:
+                raise ValueError("missing feeds: %s" % sorted(missing))
+        sizes = {k: a.shape[0] for k, a in feed.items() if a.ndim}
+        if not sizes:
+            raise ValueError("feeds need a leading batch dim to serve")
+        if len(set(sizes.values())) > 1:
+            raise ValueError("feeds disagree on batch size: %s" % sizes)
+        n = next(iter(sizes.values()))
+        bucket_for(n, self.ladder)  # validates n fits the ladder
+        if self.seq_ladder:
+            for a in feed.values():
+                if a.ndim >= 2:
+                    # reject an over-long sequence at the door, not inside
+                    # a batch where it would fail innocent co-riders
+                    bucket_for(a.shape[1], self.seq_ladder)
+        try:
+            self._admission.acquire(n)
+        except ServerOverloadedError:
+            self.metrics_.observe_rejected()
+            raise
+        timeout_s = (timeout_s if timeout_s is not None
+                     else self.default_timeout_s)
+        now = self._batcher.now()
+        req = Request(feed, n, Future(), now,
+                      deadline=(now + timeout_s
+                                if timeout_s is not None else None))
+        try:
+            self._batcher.put(req)
+        except RuntimeError:
+            self._admission.release(n)
+            raise RuntimeError("ServingEngine is shut down")
+        return req.future
+
+    def predict(self, feed, timeout_s=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(feed, timeout_s=timeout_s).result(timeout_s)
+
+    def warmup(self, example_feed=None):
+        """Pre-compile every (batch rung x seq rung) bucket on every
+        replica, so the first real request at any bucket hits a warm
+        executable. ``example_feed`` is one representative example
+        (leading dim 1) — with a ``seq_ladder``, give it the SHORTEST
+        sequence you expect, since padding can only lengthen it: seq
+        rungs below the example's length can't be warmed from it and are
+        skipped. Returns the number of (replica, bucket) compilations
+        actually warmed."""
+        from .buckets import BucketError
+
+        feed = example_feed
+        if feed is None:
+            feed = self._synthesize_example()
+        feed = {k: np.asarray(v) for k, v in feed.items()}
+        warmed = 0
+        seq_rungs = self.seq_ladder or (None,)
+        for w in self._workers:
+            for rung in self.ladder:
+                for s in seq_rungs:
+                    try:
+                        padded, _ = pad_to_bucket(
+                            feed, (rung,),
+                            seq_ladder=None if s is None else (s,))
+                    except BucketError:
+                        continue  # example longer than this seq rung
+                    w.predictor.run(padded)
+                    w.seen_signatures.add(self._signature(padded))
+                    warmed += 1
+        return warmed
+
+    def metrics(self):
+        return self.metrics_.snapshot()
+
+    def metrics_report(self):
+        return self.metrics_.report()
+
+    def compiled_shape_counts(self):
+        """Distinct dispatched feed signatures per replica — the bound the
+        ladder guarantees (<= len(ladder), or len(ladder)*len(seq_ladder)
+        with sequence bucketing). For program-path replicas this mirrors
+        the Executor's real compile-cache size."""
+        return [len(w.seen_signatures) for w in self._workers]
+
+    def shutdown(self, drain=True, timeout_s=None):
+        """Stop intake; with ``drain`` serve everything queued, otherwise
+        cancel it. Joins the worker threads. Idempotent."""
+        self._closed = True
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        if not drain:
+            for r in self._batcher.drain():
+                if r.future.cancel():
+                    self.metrics_.observe_expired()
+                else:
+                    self.metrics_.observe_failed()
+                self._admission.release(r.n)
+        self._batcher.close()
+        for w in self._workers:
+            if w.thread is not None:
+                w.thread.join(timeout_s)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- worker side --------------------------------------------------------
+    @staticmethod
+    def _signature(feed):
+        return tuple(sorted((k, a.shape, str(a.dtype))
+                            for k, a in feed.items()))
+
+    def _synthesize_example(self):
+        """Build a 1-example feed from the program's var metadata (program
+        path only — the StableHLO manifest doesn't carry shapes)."""
+        prog = getattr(self._workers[0].predictor, "_program", None)
+        if prog is None or not self.feed_names:
+            raise ValueError("warmup() needs example_feed for this "
+                             "predictor type")
+        feed = {}
+        for name in self.feed_names:
+            var = prog.global_block().var(name)
+            shape = [1 if (d is None or d < 0) else int(d)
+                     for d in (var.shape or (1,))]
+            shape[0] = 1
+            dtype = np.dtype(var.dtype or "float32")
+            if dtype.kind in "iu":
+                feed[name] = np.zeros(shape, dtype=dtype)
+            else:
+                feed[name] = np.full(shape, 0.5, dtype=dtype)
+        return feed
+
+    def _worker_loop(self, worker):
+        while True:
+            batch = self._batcher.get_batch()
+            if batch is None:
+                return
+            try:
+                self._serve_batch(worker, batch)
+            except BaseException:
+                # _serve_batch already failed the batch's futures; a throw
+                # reaching here (e.g. from metrics accounting) must not
+                # take the replica down with it
+                pass
+
+    def _serve_batch(self, worker, batch):
+        now = self._batcher.now()
+        live = []
+        for r in batch:
+            if r.future.cancelled():
+                self._admission.release(r.n)
+                continue
+            if r.deadline is not None and now > r.deadline:
+                self._fail(r, DeadlineExceededError(
+                    "request waited %.1f ms, deadline was %.1f ms"
+                    % ((now - r.enqueue_t) * 1e3,
+                       (r.deadline - r.enqueue_t) * 1e3)))
+                self.metrics_.observe_expired()
+                continue
+            live.append(r)
+        if not live:
+            return
+        try:
+            if len(live) == 1:
+                merged = live[0].feed
+            else:
+                merged = {}
+                for k in live[0].feed:
+                    vals = [r.feed[k] for r in live]
+                    if vals[0].ndim == 0:
+                        # scalar feeds (temperature etc.) have no batch dim
+                        # to concatenate on; they can share one XLA call
+                        # only when every request agrees on the value
+                        if any(not np.array_equal(v, vals[0])
+                               for v in vals[1:]):
+                            raise ValueError(
+                                "scalar feed %r differs across batched "
+                                "requests; scalars must be equal to "
+                                "coalesce" % k)
+                        merged[k] = vals[0]
+                    else:
+                        if self.seq_ladder and vals[0].ndim >= 2:
+                            # different seq lengths in one micro-batch:
+                            # pad each rider to the rung covering the
+                            # longest before the rows can concatenate
+                            tgt = bucket_for(
+                                max(v.shape[1] for v in vals),
+                                self.seq_ladder)
+                            vals = [edge_pad(v, tgt, 1) for v in vals]
+                        merged[k] = np.concatenate(vals, axis=0)
+            padded, n = pad_to_bucket(merged, self.ladder,
+                                      seq_ladder=self.seq_ladder)
+            rung = bucket_for(n, self.ladder)
+            sig = self._signature(padded)
+            hit = sig in worker.seen_signatures
+            worker.seen_signatures.add(sig)
+            outs = worker.predictor.run(padded)
+            outs = unpad_fetch(outs, n, padded_to=rung)
+        except Exception as e:
+            # fail only this batch; the replica (and its clone-shared
+            # weights) keep serving
+            for r in live:
+                self._fail(r, e)
+            self.metrics_.observe_failed(len(live))
+            return
+        self.metrics_.observe_batch(actual=n, bucket=rung, cache_hit=hit)
+        done_t = self._batcher.now()
+        off = 0
+        for r in live:
+            rows = [o[off:off + r.n]
+                    if (getattr(o, "ndim", 0) >= 1 and o.shape[0] == n)
+                    else o for o in outs]
+            off += r.n
+            try:
+                r.future.set_result(rows)
+            except Exception:
+                pass  # racing cancel; capacity still returns below
+            self.metrics_.observe_completed(done_t - r.enqueue_t)
+            self._admission.release(r.n)
+
+    def _fail(self, req, exc):
+        try:
+            req.future.set_exception(exc)
+        except Exception:
+            pass
+        self._admission.release(req.n)

@@ -1,0 +1,116 @@
+"""Serving observability, built on ``paddle_tpu.profiler``.
+
+What a serving operator actually pages on: the latency tail (p50/p95/p99
+via ``profiler.Histogram``'s sliding window), queue depth, batch occupancy
+(real examples / bucket slots — the padding tax the ladder charges for a
+bounded compile cache), and the compile-cache hit rate (misses after
+warm-up mean a shape leaked past the bucketing). Exposed both as a plain
+dict (``snapshot``) and a formatted table shaped like ``profiler._report``.
+"""
+
+import threading
+
+from ..profiler import Histogram
+
+__all__ = ["ServingMetrics"]
+
+
+class ServingMetrics:
+    def __init__(self, latency_window=8192):
+        self.latency = Histogram(max_samples=latency_window)
+        self._lock = threading.Lock()
+        self._completed = 0
+        self._failed = 0
+        self._rejected = 0
+        self._expired = 0
+        self._batches = 0
+        self._batched_examples = 0
+        self._bucket_slots = 0
+        self._cache_hits = 0
+        self._cache_misses = 0
+        self._queue_depth_fn = lambda: 0
+        self._in_flight_fn = lambda: 0
+
+    # -- wiring (the engine hands us its live gauges) -----------------------
+    def bind_gauges(self, queue_depth_fn, in_flight_fn):
+        self._queue_depth_fn = queue_depth_fn
+        self._in_flight_fn = in_flight_fn
+
+    # -- observation points -------------------------------------------------
+    def observe_completed(self, latency_s):
+        self.latency.add(latency_s)
+        with self._lock:
+            self._completed += 1
+
+    def observe_failed(self, n=1):
+        with self._lock:
+            self._failed += n
+
+    def observe_rejected(self, n=1):
+        with self._lock:
+            self._rejected += n
+
+    def observe_expired(self, n=1):
+        with self._lock:
+            self._expired += n
+
+    def observe_batch(self, actual, bucket, cache_hit):
+        with self._lock:
+            self._batches += 1
+            self._batched_examples += actual
+            self._bucket_slots += bucket
+            if cache_hit:
+                self._cache_hits += 1
+            else:
+                self._cache_misses += 1
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self):
+        with self._lock:
+            batches = self._batches
+            occupancy = (self._batched_examples / self._bucket_slots
+                         if self._bucket_slots else None)
+            lookups = self._cache_hits + self._cache_misses
+            snap = {
+                "requests_completed": self._completed,
+                "requests_failed": self._failed,
+                "requests_rejected": self._rejected,
+                "requests_expired": self._expired,
+                "queue_depth": self._queue_depth_fn(),
+                "in_flight": self._in_flight_fn(),
+                "batches": batches,
+                "batch_occupancy": occupancy,
+                "avg_batch_size": (self._batched_examples / batches
+                                   if batches else None),
+                "compile_cache_hits": self._cache_hits,
+                "compile_cache_misses": self._cache_misses,
+                "compile_cache_hit_rate": (self._cache_hits / lookups
+                                           if lookups else None),
+            }
+        lat = self.latency.percentiles((50, 95, 99))
+        snap["latency_s"] = {k: lat[k] for k in ("p50", "p95", "p99")}
+        return snap
+
+    def report(self):
+        """Formatted table in the ``profiler._report`` house style."""
+        s = self.snapshot()
+        lines = ["%-32s %14s" % ("Serving metric", "Value")]
+
+        def fmt(v):
+            if v is None:
+                return "-"
+            if isinstance(v, float):
+                return "%.4f" % v
+            return "%d" % v
+
+        for key in ("requests_completed", "requests_failed",
+                    "requests_rejected", "requests_expired", "queue_depth",
+                    "in_flight", "batches", "avg_batch_size",
+                    "batch_occupancy", "compile_cache_hits",
+                    "compile_cache_misses", "compile_cache_hit_rate"):
+            lines.append("%-32s %14s" % (key, fmt(s[key])))
+        for k, v in s["latency_s"].items():
+            lines.append("%-32s %14s" % (
+                "latency_%s_ms" % k,
+                "-" if v is None else "%.3f" % (v * 1e3)))
+        return "\n".join(lines)

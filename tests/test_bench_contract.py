@@ -32,6 +32,21 @@ def test_build_contract(monkeypatch):
     assert specs["deepfm"][0].extras["row_latency_s_per_example"] > 0
 
 
+def test_serving_bench_record(monkeypatch):
+    """The serving config emits the same record shape as the BASELINE
+    configs and a finite p99-budget ratio (bench.py _bench_serving)."""
+    import bench
+
+    monkeypatch.setenv("BENCH_SERVING_REQUESTS", "16")
+    monkeypatch.setenv("BENCH_SERVING_CLIENTS", "2")
+    monkeypatch.setenv("BENCH_SERVING_REPLICAS", "1")
+    rec = bench._bench_serving(on_tpu=False)
+    assert rec["metric"] == "serving_requests_per_sec"
+    assert rec["unit"] == "requests/sec"
+    assert rec["value"] > 0
+    assert rec["vs_baseline"] > 0
+
+
 def test_seq_override_metric_suffix(monkeypatch):
     import bench
 

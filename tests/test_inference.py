@@ -61,6 +61,44 @@ def test_predictor_clone_shares_weights(tmp_path):
     np.testing.assert_allclose(a, b, rtol=0, atol=0)
 
 
+def test_predictor_clone_concurrent_distinct_shapes(tmp_path):
+    """N threads, each its own clone, each a DISTINCT feed shape (so each
+    thread compiles its own executable), all sharing one weight scope —
+    results must match the unthreaded baseline. Pins the scope-sharing
+    contract at inference.py Predictor.run: explicit scope, no state
+    donation (a donated shared weight buffer would be use-after-free under
+    another thread's feet)."""
+    import threading
+
+    xs, _ = _train_and_save(tmp_path)
+    pred = Predictor(str(tmp_path / "model"))
+    shapes = [1, 2, 3, 5]
+    rng = np.random.RandomState(3)
+    feeds = [rng.randn(n, 8).astype("f4") for n in shapes]
+    want = [pred.run({"x": f})[0] for f in feeds]
+
+    results = [None] * len(feeds)
+    errors = []
+
+    def work(i, clone):
+        try:
+            for _ in range(3):  # repeat: warm-cache path must stay stable
+                out, = clone.run({"x": feeds[i]})
+            results[i] = out
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i, pred.clone()))
+               for i in range(len(feeds))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for got, ref in zip(results, want):
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
 def test_predictor_combined_file_config(tmp_path):
     xs, want = _train_and_save(tmp_path)
     import os

@@ -1,0 +1,510 @@
+"""paddle_tpu.serving: bucket ladder, dynamic batcher (fake clock — no
+sleeps), ServingEngine end-to-end (ISSUE acceptance: 100 mixed-size
+requests, bounded compiles, metrics), overload fast-fail, worker-crash
+containment, deadlines, and a slow-marked soak."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import serving
+from paddle_tpu.serving import (BucketError, DeadlineExceededError,
+                                DynamicBatcher, Request, ServingEngine,
+                                ServerOverloadedError, bucket_for,
+                                pad_to_bucket, pow2_ladder, unpad_fetch)
+
+from test_inference import _train_and_save
+
+
+# ---------------------------------------------------------------------------
+# buckets
+# ---------------------------------------------------------------------------
+
+def test_pow2_ladder():
+    assert pow2_ladder(8) == (1, 2, 4, 8)
+    assert pow2_ladder(6) == (1, 2, 4, 6)
+    assert pow2_ladder(1) == (1,)
+    with pytest.raises(ValueError):
+        pow2_ladder(0)
+
+
+def test_bucket_for():
+    ladder = (1, 2, 4, 8)
+    assert bucket_for(1, ladder) == 1
+    assert bucket_for(3, ladder) == 4
+    assert bucket_for(8, ladder) == 8
+    with pytest.raises(BucketError):
+        bucket_for(9, ladder)
+
+
+def test_pad_to_bucket_edge_padding():
+    feed = {"x": np.arange(6, dtype="f4").reshape(3, 2),
+            "ids": np.array([[5], [6], [7]], dtype="i8")}
+    padded, n = pad_to_bucket(feed, (1, 2, 4, 8))
+    assert n == 3
+    assert padded["x"].shape == (4, 2)
+    # edge padding replicates the last real row — ids stay in-vocabulary
+    np.testing.assert_array_equal(padded["x"][3], feed["x"][2])
+    np.testing.assert_array_equal(padded["ids"][3], [7])
+    outs = unpad_fetch([padded["x"] * 2], n)
+    assert outs[0].shape == (3, 2)
+    # padded_to pins slicing to the padded batch: a non-batch output that
+    # is merely longer than n passes through untouched
+    keep, = unpad_fetch([np.arange(16)], 3, padded_to=4)
+    assert keep.shape == (16,)
+    cut, = unpad_fetch([np.zeros((4, 2))], 3, padded_to=4)
+    assert cut.shape == (3, 2)
+    # scalar feeds carry no batch dim: excluded from consensus, unpadded
+    padded, n = pad_to_bucket({"x": np.ones((3, 2), "f4"),
+                               "temp": np.float32(2.0)}, (4,))
+    assert padded["temp"].shape == () and padded["x"].shape == (4, 2)
+
+
+def test_pad_to_bucket_seq_ladder():
+    feed = {"tok": np.ones((3, 5), dtype="i8")}
+    padded, n = pad_to_bucket(feed, (4,), seq_ladder=(8, 16))
+    assert padded["tok"].shape == (4, 8) and n == 3
+
+
+def test_pad_to_bucket_rejects_mismatch_and_empty():
+    with pytest.raises(ValueError, match="disagree"):
+        pad_to_bucket({"a": np.ones((2, 1)), "b": np.ones((3, 1))}, (4,))
+    with pytest.raises(BucketError):
+        pad_to_bucket({"a": np.ones((9, 1))}, (1, 2, 4, 8))
+
+
+# ---------------------------------------------------------------------------
+# batcher — fake clock, fully deterministic, zero sleeps (tier-1)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _req(clock, n=1, deadline=None):
+    from concurrent.futures import Future
+    return Request({"x": np.zeros((n, 2), "f4")}, n, Future(), clock(),
+                   deadline=deadline)
+
+
+def test_batcher_full_cut_no_wait():
+    clock = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_wait_ms=50, clock=clock)
+    for _ in range(4):
+        b.put(_req(clock))
+    batch = b.get_batch()  # full: returns without consulting the deadline
+    assert [r.n for r in batch] == [1, 1, 1, 1]
+    assert b.depth() == 0
+
+
+def test_batcher_deadline_cut_via_fake_clock():
+    clock = FakeClock()
+    b = DynamicBatcher(max_batch_size=8, max_wait_ms=5, clock=clock)
+    b.put(_req(clock))
+    b.put(_req(clock))
+    clock.advance(0.006)  # oldest request is now past max_wait
+    batch = b.get_batch()
+    assert len(batch) == 2
+    assert b.depth() == 0
+
+
+def test_batcher_greedy_cut_respects_max_batch():
+    clock = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_wait_ms=0, clock=clock)
+    b.put(_req(clock, n=3))
+    b.put(_req(clock, n=2))  # 3 + 2 > 4: stays queued for the next cut
+    batch = b.get_batch()
+    assert [r.n for r in batch] == [3]
+    assert b.depth() == 2
+    batch = b.get_batch()
+    assert [r.n for r in batch] == [2]
+
+
+def test_batcher_oversize_head_served_solo():
+    clock = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_wait_ms=0, clock=clock)
+    b.put(_req(clock, n=6))  # engine validates earlier; batcher must not hang
+    assert [r.n for r in b.get_batch()] == [6]
+
+
+def test_batcher_close_drains_then_none():
+    clock = FakeClock()
+    b = DynamicBatcher(max_batch_size=4, max_wait_ms=1000, clock=clock)
+    b.put(_req(clock))
+    b.close()
+    assert len(b.get_batch()) == 1  # closed: cut immediately, no deadline
+    assert b.get_batch() is None
+    with pytest.raises(RuntimeError):
+        b.put(_req(clock))
+
+
+# ---------------------------------------------------------------------------
+# engine — fake predictor (deterministic, no XLA in the control-flow tests)
+# ---------------------------------------------------------------------------
+
+class FakePredictor:
+    """Doubles its input; optional gate to hold the worker mid-run and a
+    poison value that raises (worker-crash path)."""
+    feed_names = ["x"]
+    fetch_names = ["y"]
+
+    def __init__(self, gate=None):
+        self.gate = gate
+
+    def run(self, feed, return_numpy=True):
+        if self.gate is not None:
+            assert self.gate.wait(5.0), "test gate never opened"
+        x = np.asarray(feed["x"])
+        if np.any(x == -777):
+            raise RuntimeError("poisoned batch")
+        return [x * 2.0]
+
+    def clone(self):
+        return FakePredictor(self.gate)
+
+
+def _drain_queue(eng, timeout=5.0):
+    t0 = time.time()
+    while eng._batcher.depth() > 0:
+        assert time.time() - t0 < timeout, "queue never drained"
+        time.sleep(0.001)
+
+
+def test_engine_overload_fast_fails_while_in_flight_completes():
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1,
+                        ladder=(1, 2, 4), max_wait_ms=0, max_queue_depth=4)
+    try:
+        first = eng.submit({"x": np.full((1, 2), 3.0, "f4")})
+        _drain_queue(eng)  # worker holds `first` at the gate
+        backlog = [eng.submit({"x": np.full((1, 2), float(i), "f4")})
+                   for i in range(3)]  # in_flight now at the depth limit
+        with pytest.raises(ServerOverloadedError):
+            eng.submit({"x": np.zeros((1, 2), "f4")})
+        m = eng.metrics()
+        assert m["requests_rejected"] == 1
+        gate.set()  # overload must not have hurt admitted requests
+        np.testing.assert_array_equal(first.result(5.0)[0],
+                                      np.full((1, 2), 6.0))
+        for i, f in enumerate(backlog):
+            np.testing.assert_array_equal(f.result(5.0)[0],
+                                          np.full((1, 2), 2.0 * i))
+    finally:
+        gate.set()
+        eng.shutdown()
+    m = eng.metrics()
+    assert m["requests_completed"] == 4
+    assert eng._admission.in_flight == 0
+
+
+def test_engine_worker_crash_fails_batch_only():
+    eng = ServingEngine(FakePredictor(), num_replicas=1,
+                        ladder=(1, 2), max_wait_ms=0, max_queue_depth=16)
+    try:
+        bad = eng.submit({"x": np.full((1, 2), -777.0, "f4")})
+        with pytest.raises(RuntimeError, match="poisoned"):
+            bad.result(5.0)
+        good = eng.submit({"x": np.ones((1, 2), "f4")})
+        np.testing.assert_array_equal(good.result(5.0)[0],
+                                      np.full((1, 2), 2.0))
+        m = eng.metrics()
+        assert m["requests_failed"] == 1 and m["requests_completed"] == 1
+    finally:
+        eng.shutdown()
+    assert eng._admission.in_flight == 0
+
+
+def test_engine_deadline_expires_queued_request():
+    clock = FakeClock()
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1,
+                        ladder=(1, 2), max_wait_ms=0, max_queue_depth=8,
+                        clock=clock)
+    try:
+        blocker = eng.submit({"x": np.ones((1, 2), "f4")})
+        _drain_queue(eng)
+        doomed = eng.submit({"x": np.ones((1, 2), "f4")}, timeout_s=5.0)
+        clock.advance(10.0)  # past the deadline while still queued
+        gate.set()
+        with pytest.raises(DeadlineExceededError):
+            doomed.result(5.0)
+        assert blocker.result(5.0)
+        assert eng.metrics()["requests_expired"] == 1
+    finally:
+        gate.set()
+        eng.shutdown()
+
+
+def test_engine_rejects_oversize_and_shutdown_submit():
+    eng = ServingEngine(FakePredictor(), ladder=(1, 2, 4), max_wait_ms=0)
+    with pytest.raises(BucketError):
+        eng.submit({"x": np.ones((5, 2), "f4")})
+    eng.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        eng.submit({"x": np.ones((1, 2), "f4")})
+
+
+def test_engine_shutdown_no_drain_cancels_queued():
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1,
+                        ladder=(1,), max_wait_ms=0, max_queue_depth=8)
+    running = eng.submit({"x": np.ones((1, 2), "f4")})
+    _drain_queue(eng)  # worker holds `running` at the gate
+    queued = eng.submit({"x": np.ones((1, 2), "f4")})
+    # drain=False while the worker is still gated: `queued` must be
+    # cancelled, the in-flight request must still complete
+    eng.shutdown(drain=False, timeout_s=0.2)
+    assert queued.cancelled()
+    gate.set()
+    assert running.result(5.0)
+    for w in eng._workers:
+        w.thread.join(5.0)
+    assert eng._admission.in_flight == 0
+
+
+def test_engine_scalar_feed_coalescing():
+    """0-d feeds can't concatenate: equal scalars share the batch, a
+    disagreeing scalar fails only that batch (not the worker)."""
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1,
+                        ladder=(1, 2, 4), max_wait_ms=0, max_queue_depth=16)
+    try:
+        blocker = eng.submit({"x": np.ones((1, 2), "f4")})
+        _drain_queue(eng)
+        same = [eng.submit({"x": np.full((1, 2), float(i), "f4"),
+                            "temp": np.float32(2.0)}) for i in range(2)]
+        gate.set()
+        assert blocker.result(5.0)
+        for i, f in enumerate(same):
+            np.testing.assert_array_equal(f.result(5.0)[0],
+                                          np.full((1, 2), 2.0 * i))
+        gate.clear()
+        blocker2 = eng.submit({"x": np.ones((1, 2), "f4")})
+        _drain_queue(eng)
+        differ = [eng.submit({"x": np.ones((1, 2), "f4"),
+                              "temp": np.float32(t)}) for t in (1.0, 3.0)]
+        gate.set()
+        assert blocker2.result(5.0)
+        for f in differ:
+            with pytest.raises(ValueError, match="scalar feed"):
+                f.result(5.0)
+        # the replica survives the failed batch
+        after = eng.submit({"x": np.ones((1, 2), "f4")})
+        assert after.result(5.0)
+    finally:
+        gate.set()
+        eng.shutdown()
+    assert eng._admission.in_flight == 0
+
+
+def test_engine_coalesces_mixed_seq_lengths():
+    """Two riders with different sequence lengths in ONE micro-batch:
+    each is edge-padded to the rung covering the longest before the rows
+    concatenate (the variable-length text case seq_ladder exists for)."""
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1,
+                        ladder=(1, 2, 4), seq_ladder=(8, 16),
+                        max_wait_ms=0, max_queue_depth=16)
+    try:
+        blocker = eng.submit({"x": np.ones((1, 5), "f4")})
+        _drain_queue(eng)
+        a = eng.submit({"x": np.full((1, 5), 2.0, "f4")})
+        b = eng.submit({"x": np.full((1, 7), 3.0, "f4")})
+        gate.set()
+        assert blocker.result(5.0)
+        ra, = a.result(5.0)
+        rb, = b.result(5.0)
+        assert ra.shape == (1, 8) and rb.shape == (1, 8)
+        np.testing.assert_array_equal(ra, np.full((1, 8), 4.0))
+        np.testing.assert_array_equal(rb, np.full((1, 8), 6.0))
+        # an over-long sequence is rejected at the door, not in-batch
+        with pytest.raises(BucketError):
+            eng.submit({"x": np.ones((1, 17), "f4")})
+        assert eng.metrics()["requests_failed"] == 0
+    finally:
+        gate.set()
+        eng.shutdown()
+
+
+def test_engine_warmup_covers_seq_ladder():
+    eng = ServingEngine(FakePredictor(), num_replicas=1, ladder=(1, 2),
+                        seq_ladder=(4, 8), max_wait_ms=0)
+    try:
+        # example seq len 3 pads up to both rungs: 2 batch x 2 seq buckets
+        assert eng.warmup({"x": np.ones((1, 3), "f4")}) == 4
+        assert eng.compiled_shape_counts() == [4]
+        got = eng.submit({"x": np.ones((1, 3), "f4")}).result(5.0)
+        # batch dim is unpadded; the seq dim stays at its rung (which
+        # outputs carry a seq dim is model-dependent — callers slice)
+        assert got[0].shape == (1, 4)
+        assert eng.metrics()["compile_cache_hit_rate"] == 1.0
+    finally:
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# engine — end-to-end over the real Predictor (ISSUE acceptance criteria)
+# ---------------------------------------------------------------------------
+
+def test_serving_engine_end_to_end(tmp_path):
+    """Ladder {1,2,4,8}, 100 mixed-size requests: correct outputs, at most
+    len(ladder) compiled shapes per replica, metrics report queue depth /
+    batch occupancy / p50-p95-p99 latency."""
+    xs, want = _train_and_save(tmp_path)
+    from paddle_tpu.inference import Predictor
+
+    oracle = Predictor(str(tmp_path / "model"))
+    ladder = (1, 2, 4, 8)
+    eng = ServingEngine(str(tmp_path / "model"), num_replicas=2,
+                        ladder=ladder, max_wait_ms=2, max_queue_depth=1000)
+    try:
+        assert eng.warmup() == len(ladder) * 2
+
+        rng = np.random.RandomState(7)
+        sizes = [int(rng.choice([1, 2, 3, 5, 8])) for _ in range(100)]
+        feeds = [rng.randn(n, 8).astype("f4") for n in sizes]
+        futures = [eng.submit({"x": f}) for f in feeds]
+        for f, x in zip(futures, feeds):
+            got, = f.result(30.0)
+            ref, = oracle.run({"x": x})
+            assert got.shape == ref.shape
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+        # bounded compiles: every replica dispatched at most len(ladder)
+        # distinct padded shapes, and the program-path Executor cache agrees
+        assert all(c <= len(ladder) for c in eng.compiled_shape_counts())
+        for w in eng._workers:
+            assert len(w.predictor._exe._cache) <= len(ladder)
+
+        m = eng.metrics()
+        assert m["requests_completed"] == 100
+        assert m["requests_failed"] == 0
+        assert m["queue_depth"] == 0
+        assert 0 < m["batch_occupancy"] <= 1.0
+        for p in ("p50", "p95", "p99"):
+            assert m["latency_s"][p] is not None and m["latency_s"][p] > 0
+        # warmed every rung up front: live traffic never compiled
+        assert m["compile_cache_hit_rate"] == 1.0
+        report = eng.metrics_report()
+        for token in ("queue_depth", "batch_occupancy", "latency_p99_ms"):
+            assert token in report
+    finally:
+        eng.shutdown(drain=True)
+
+
+def test_serving_engine_stablehlo_predictor(tmp_path):
+    """The engine accepts either predictor type (clone parity satellite)."""
+    xs, want = _train_and_save(tmp_path)
+    from paddle_tpu.inference import load_stablehlo_predictor
+
+    base = load_stablehlo_predictor(str(tmp_path / "model"))
+    twin = base.clone()
+    a, = base.run({"x": xs})
+    b, = twin.run({"x": xs})
+    np.testing.assert_array_equal(a, b)
+    if base.batch_mode != "symbolic":
+        pytest.skip("pinned-batch export can't bucket")
+    eng = ServingEngine(base, num_replicas=2, ladder=(1, 2, 4),
+                        max_wait_ms=1, max_queue_depth=100)
+    try:
+        futs = [eng.submit({"x": xs[i % 2:i % 2 + 1]}) for i in range(10)]
+        for i, f in enumerate(futs):
+            got, = f.result(30.0)
+            np.testing.assert_allclose(got, want[i % 2:i % 2 + 1],
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        eng.shutdown()
+
+
+@pytest.mark.slow
+def test_serving_soak_sustained_load(tmp_path):
+    """Soak: multi-threaded clients sustain load >= 2s; nothing fails,
+    nothing leaks, the tail stays finite."""
+    _train_and_save(tmp_path)
+    eng = ServingEngine(str(tmp_path / "model"), num_replicas=2,
+                        ladder=(1, 2, 4, 8), max_wait_ms=2,
+                        max_queue_depth=64)
+    stop = time.time() + 2.5
+    errors = []
+    rejected = [0]
+    lock = threading.Lock()
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        while time.time() < stop:
+            x = rng.randn(int(rng.randint(1, 4)), 8).astype("f4")
+            try:
+                out, = eng.submit({"x": x}).result(10.0)
+                if out.shape[0] != x.shape[0]:
+                    raise AssertionError("shape mismatch")
+            except ServerOverloadedError:
+                with lock:
+                    rejected[0] += 1
+                time.sleep(0.002)  # backoff, as the error contract asks
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+    try:
+        eng.warmup()
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors[:3]
+        m = eng.metrics()
+        assert m["requests_completed"] > 50
+        assert m["requests_failed"] == 0
+        assert m["latency_s"]["p99"] is not None
+        assert all(c <= 4 for c in eng.compiled_shape_counts())
+    finally:
+        eng.shutdown(drain=True)
+    assert eng._admission.in_flight == 0
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+def test_stop_profiler_silent(capsys):
+    from paddle_tpu import profiler
+
+    profiler.reset_profiler()
+    profiler.start_profiler()
+    with profiler.record_event("serve"):
+        pass
+    report = profiler.stop_profiler(silent=True)
+    assert "serve" in report
+    assert capsys.readouterr().out == ""
+    profiler.start_profiler()  # default path still prints
+    profiler.stop_profiler()
+    assert "Event" in capsys.readouterr().out
+
+
+def test_profiler_histogram_percentiles():
+    from paddle_tpu.profiler import Histogram
+
+    h = Histogram(max_samples=100)
+    assert h.percentile(99) is None
+    for v in range(1, 101):
+        h.add(v / 1000.0)
+    ps = h.percentiles((50, 95, 99))
+    assert ps["p50"] == pytest.approx(0.050, abs=0.002)
+    assert ps["p99"] == pytest.approx(0.099, abs=0.002)
+    assert h.count == 100
+    assert h.cdf(0.050) == pytest.approx(0.5, abs=0.02)
+    # sliding window: old samples age out
+    for _ in range(100):
+        h.add(1.0)
+    assert h.percentile(50) == 1.0 and h.count == 200
