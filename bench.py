@@ -26,6 +26,7 @@ import argparse
 import json
 import os
 import time
+import warnings
 
 import numpy as np
 
@@ -53,7 +54,8 @@ def _peak_flops(device):
 
 
 def _build(model, on_tpu, seq_override=None):
-    """Returns (spec, batch, metric_name, unit, per_example)."""
+    """Returns (spec, batch, metric_name, unit, per_example, seq_len).
+    ``seq_len`` is None for the non-sequence configs."""
     from paddle_tpu import models
 
     if model == "transformer":
@@ -79,8 +81,22 @@ def _build(model, on_tpu, seq_override=None):
                 "transformer_base_seq%d_tokens_per_sec_per_chip" % seq_len)
         spec = models.transformer.transformer_base(
             seq_len=seq_len, dropout_rate=0.1)
-        batch = max(1, (128 * 256) // seq_len) if on_tpu else 4
-        return spec, batch, name, "tokens/sec", spec.tokens_per_example
+        token_budget = 128 * 256
+        batch = max(1, token_budget // seq_len) if on_tpu else 4
+        if on_tpu and batch * seq_len != token_budget:
+            # ROADMAP item 5 standing bug: this rounding used to be silent,
+            # making vs_baseline incomparable across seq_len values that
+            # don't divide the token budget. The effective config now also
+            # rides in every bench JSON line (see _bench_static).
+            warnings.warn(
+                "transformer batch auto-scale ROUNDED DOWN: seq_len=%d "
+                "does not divide the %d-token/step budget, so batch=%d "
+                "gives %d tokens/step — throughput is measured at the "
+                "effective config emitted in the bench record, not the "
+                "nominal budget" % (seq_len, token_budget, batch,
+                                    batch * seq_len), RuntimeWarning)
+        return spec, batch, name, "tokens/sec", spec.tokens_per_example, \
+            seq_len
     if model == "bert":
         seq_len = 128 if on_tpu else 32
         spec = models.bert.bert_base(seq_len=seq_len) if on_tpu else \
@@ -88,7 +104,7 @@ def _build(model, on_tpu, seq_override=None):
                                   d_model=128, d_ff=256, n_layer=2)
         batch = 128 if on_tpu else 4
         return (spec, batch, "bert_base_tokens_per_sec_per_chip",
-                "tokens/sec", spec.tokens_per_example)
+                "tokens/sec", spec.tokens_per_example, seq_len)
     if model == "resnet50":
         spec = models.resnet.resnet_imagenet(depth=50) if on_tpu else \
             models.resnet.resnet_imagenet(depth=50, class_num=10,
@@ -96,14 +112,14 @@ def _build(model, on_tpu, seq_override=None):
         batch = int(os.environ.get("BENCH_RESNET_BATCH", 128)) \
             if on_tpu else 2
         return (spec, batch, "resnet50_images_per_sec_per_chip",
-                "images/sec", 1)
+                "images/sec", 1, None)
     if model == "deepfm":
         spec = models.deepfm.deepfm() if on_tpu else \
             models.deepfm.deepfm(sparse_feature_dim=1000,
                                  hidden_sizes=(64, 64))
         batch = 32768 if on_tpu else 16
         return (spec, batch, "deepfm_examples_per_sec_per_chip",
-                "examples/sec", 1)
+                "examples/sec", 1, None)
     raise SystemExit("unknown model %r" % model)
 
 
@@ -113,11 +129,12 @@ def _bench_static(model, on_tpu, seq_override=None):
     import paddle_tpu as fluid
 
     main_prog, startup = fluid.Program(), fluid.Program()
+    amp_on = os.environ.get("BENCH_AMP", "1") == "1"
     with fluid.program_guard(main_prog, startup):
-        spec, batch, metric, unit, per_example = _build(
+        spec, batch, metric, unit, per_example, seq_len = _build(
             model, on_tpu, seq_override)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
-        if os.environ.get("BENCH_AMP", "1") == "1":
+        if amp_on:
             opt = fluid.amp.decorate(opt)  # bf16 MXU compute
         opt.minimize(spec.loss)
 
@@ -148,6 +165,10 @@ def _bench_static(model, on_tpu, seq_override=None):
 
     examples_per_sec = batch * per_example * steps / dt
     dev = jax.devices()[0]
+    # the self-describing record (ROADMAP item 5): every floor constant a
+    # vs_baseline re-derivation needs rides in the line itself
+    config = {"batch": batch, "seq_len": seq_len, "steps": steps,
+              "amp": amp_on, "peak_flops": _peak_flops(dev)}
     if model == "deepfm":
         # roofline basis: embedding-bound CTR is per-ROW-LATENCY-bound on
         # TPU, so the floor sums the MLP's MXU time with the measured
@@ -156,14 +177,17 @@ def _bench_static(model, on_tpu, seq_override=None):
         # properties like the measured HBM stream rate)
         floor_s = ((spec.flops_per_example or 0) / _peak_flops(dev)
                    + spec.extras["row_latency_s_per_example"])
+        config["row_latency_s_per_example"] = \
+            spec.extras["row_latency_s_per_example"]
         target = 0.45 / max(floor_s, 1e-30)   # 45% of roofline examples/s
         vsb = (examples_per_sec / per_example) / target
     else:
         flops_per_step = (spec.flops_per_example or 0) * batch
         mfu = (flops_per_step * steps / dt) / _peak_flops(dev)
         vsb = mfu / 0.45
+    config["flops_per_example"] = spec.flops_per_example
     return {"metric": metric, "value": round(examples_per_sec, 1),
-            "unit": unit, "vs_baseline": round(vsb, 4)}
+            "unit": unit, "vs_baseline": round(vsb, 4), "config": config}
 
 
 def _bench_serving(on_tpu):
@@ -186,6 +210,8 @@ def _bench_serving(on_tpu):
                                   2000 if on_tpu else 300))
     clients = int(os.environ.get("BENCH_SERVING_CLIENTS", 4))
     replicas = int(os.environ.get("BENCH_SERVING_REPLICAS", 2))
+    max_batch_size = 8
+    max_wait_ms = 2
     p99_budget_s = 0.010 if on_tpu else 0.075
 
     main, startup = fluid.Program(), fluid.Program()
@@ -202,7 +228,8 @@ def _bench_serving(on_tpu):
                                       main_program=main)
 
     eng = serving.ServingEngine(model_dir, num_replicas=replicas,
-                                max_batch_size=8, max_wait_ms=2,
+                                max_batch_size=max_batch_size,
+                                max_wait_ms=max_wait_ms,
                                 max_queue_depth=max(64, 4 * clients))
     try:
         eng.warmup()
@@ -237,7 +264,12 @@ def _bench_serving(on_tpu):
     p99 = m["latency_s"]["p99"] or float("inf")
     return {"metric": "serving_requests_per_sec", "value": round(rps, 1),
             "unit": "requests/sec",
-            "vs_baseline": round(p99_budget_s / p99, 4)}
+            "vs_baseline": round(p99_budget_s / p99, 4),
+            "config": {"requests": requests, "clients": clients,
+                       "replicas": replicas,
+                       "max_batch_size": max_batch_size,
+                       "max_wait_ms": max_wait_ms,
+                       "p99_budget_s": p99_budget_s}}
 
 
 def _bench_bert_dygraph(on_tpu):
@@ -285,6 +317,10 @@ def _bench_bert_dygraph(on_tpu):
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec",
         "vs_baseline": round(mfu / 0.45, 4),
+        "config": {"batch": batch, "seq_len": cfg["seq_len"],
+                   "steps": steps, "amp": amp,
+                   "peak_flops": _peak_flops(jax.devices()[0]),
+                   "flops_per_example": flops_per_example},
     }
 
 

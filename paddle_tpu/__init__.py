@@ -51,6 +51,7 @@ from . import data  # noqa: F401
 from .data.feeder import DataFeeder  # noqa: F401
 from . import profiler  # noqa: F401
 from . import debugger  # noqa: F401
+from . import analysis  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import parallel  # noqa: F401
 from .version import __version__  # noqa: F401

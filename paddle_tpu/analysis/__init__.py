@@ -1,0 +1,34 @@
+"""paddle_tpu.analysis — static program verifier over the Program IR.
+
+The Python-IR counterpart of the reference's three validation layers:
+per-op ``InferShape`` (``framework/operator.h``), the ParallelExecutor SSA
+dependency graph (``details/build_strategy.cc``, ``parallel_executor.cc``)
+and the inference analysis passes (``inference/analysis/``). Runs BEFORE
+lowering, so defects are reported with the op type and the user line that
+created it instead of a ``KeyError``/XLA trace error at execution time.
+
+Use it three ways:
+
+  * ``fluid.Executor(...).run(program, ..., verify=True)`` or
+    ``PADDLE_TPU_VERIFY=1`` (``=warn`` downgrades errors to warnings) —
+    verification runs once per compiled program variant;
+  * ``analysis.analyze_program(program, fetch_names=[...])`` for the
+    result object / report;
+  * ``python -m paddle_tpu.analysis`` — CLI over the model zoo, saved
+    inference model dirs, and compiled-HLO sharding checks.
+"""
+
+from .dataflow import (  # noqa: F401
+    OpNode, Region, build_region, program_region,
+    effective_reads, effective_writes, SIDE_EFFECT_OPS)
+from .passes import (  # noqa: F401
+    Diagnostic, AnalysisResult, VerificationError, ShapeCtx,
+    analyze_program, verify_program, analyze_hlo_sharding, DEFAULT_CHECKS)
+
+__all__ = [
+    "OpNode", "Region", "build_region", "program_region",
+    "effective_reads", "effective_writes", "SIDE_EFFECT_OPS",
+    "Diagnostic", "AnalysisResult", "VerificationError", "ShapeCtx",
+    "analyze_program", "verify_program", "analyze_hlo_sharding",
+    "DEFAULT_CHECKS",
+]

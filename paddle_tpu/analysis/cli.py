@@ -1,0 +1,210 @@
+"""``python -m paddle_tpu.analysis`` — one entry point for IR verification
+and compiled-HLO sharding checks (the reference splits these across
+``inference/analysis/analyzer`` and graph passes; here they share one
+diagnostic surface).
+
+    # verify every model-zoo program (the verifier's regression corpus)
+    python -m paddle_tpu.analysis --zoo
+    # a subset, without the optimizer/backward section
+    python -m paddle_tpu.analysis --zoo mnist.mlp transformer --no-train
+    # a saved inference model directory (io.save_inference_model layout)
+    python -m paddle_tpu.analysis path/to/model_dir
+    # compiled-HLO sharding lint (Executor.lowered_hlo_text dump)
+    python -m paddle_tpu.analysis --hlo step.hlo --require-sharded fc_w
+    # demonstrate a defect class and the diagnostic it produces (exits 1)
+    python -m paddle_tpu.analysis --demo-defect double_write
+
+Exit status: 0 when every requested check is clean (warnings included —
+the zoo is held to zero findings), 1 otherwise.
+"""
+
+import argparse
+import json
+import sys
+
+from .passes import analyze_program, analyze_hlo_sharding
+
+
+def _zoo_builders():
+    """name -> zero-arg builder, CPU-sized configs (mirrors tests/
+    test_models.py). Each builds into the CURRENT default program."""
+    from .. import models
+
+    return {
+        "mnist.mlp": lambda: models.mnist.mlp(hidden_sizes=(32,)),
+        "mnist.cnn": lambda: models.mnist.cnn(),
+        "resnet.cifar10": lambda: models.resnet.resnet_cifar10(depth=8),
+        "resnet.imagenet50": lambda: models.resnet.resnet_imagenet(
+            depth=50, class_num=100, image_shape=(3, 64, 64)),
+        "vgg16": lambda: models.vgg.vgg16(image_shape=(3, 32, 32)),
+        "se_resnext50": lambda: models.se_resnext.se_resnext50(
+            image_shape=(3, 64, 64), class_num=10),
+        "stacked_lstm": lambda: models.stacked_lstm.stacked_lstm_net(
+            dict_size=100, emb_dim=16, hid_dim=16, stacked_num=2,
+            seq_len=12),
+        "transformer": lambda: models.transformer.transformer_base(
+            src_vocab=64, trg_vocab=64, seq_len=16, d_model=32, d_ff=64,
+            n_head=2, n_layer=2, dropout_rate=0.1),
+        "bert": lambda: models.bert.bert_base(
+            vocab_size=64, seq_len=16, d_model=32, d_ff=64, n_head=2,
+            n_layer=2, dropout_rate=0.1),
+        "deepfm": lambda: models.deepfm.deepfm(
+            sparse_feature_dim=1000, num_fields=6, embedding_size=4,
+            dense_dim=3, hidden_sizes=(16, 16)),
+        "word2vec": lambda: models.word2vec.ngram_lm(
+            dict_size=50, emb_dim=8, hidden_size=16),
+        "machine_translation": lambda:
+            models.machine_translation.seq2seq_attention(
+                src_vocab=40, trg_vocab=40, seq_len=10, emb_dim=16,
+                hid_dim=16),
+        "ocr_ctc": lambda: models.ocr_ctc.crnn_ctc(
+            num_classes=12, image_shape=(1, 16, 48), max_label_len=6,
+            hid_dim=16),
+        "ssd_lite": lambda: models.ssd.ssd_lite(),
+        "label_semantic_roles": lambda:
+            models.label_semantic_roles.srl_crf(),
+        "books.fit_a_line": lambda: models.books.fit_a_line(),
+        "books.understand_sentiment": lambda:
+            models.books.understand_sentiment(seq_len=12, stacked_num=2),
+        "books.recommender_system": lambda:
+            models.books.recommender_system(),
+    }
+
+
+def analyze_zoo_model(builder, train=True):
+    """Build one zoo model into fresh programs and verify main + startup.
+    Returns (main_result, startup_result)."""
+    import paddle_tpu as fluid
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        spec = builder()
+        if train:
+            fluid.optimizer.SGD(learning_rate=0.01).minimize(spec.loss)
+    fetches = [spec.loss.name] + [v.name for v in spec.fetches.values()]
+    return (analyze_program(main, fetch_names=fetches, donate_state=train),
+            analyze_program(startup))
+
+
+def build_defective_program(kind):
+    """A deliberately-broken program per defect class, for demos and the
+    CLI regression test. Returns (program, analyze_kwargs)."""
+    import paddle_tpu as fluid
+    from ..core.framework import Program, program_guard
+
+    main, startup = Program(), Program()
+    with program_guard(main, startup):
+        gb = main.global_block()
+        if kind == "use_before_def":
+            ghost = gb.create_var(name="ghost", shape=[4], dtype="float32")
+            out = gb.create_var(name="out", shape=[4], dtype="float32")
+            gb.append_op("relu", {"X": ghost}, {"Out": out})
+            return main, {"fetch_names": ["out"]}
+        if kind == "double_write":
+            x = fluid.layers.data("x", shape=[4])
+            a = gb.create_var(name="a", shape=[-1, 4], dtype="float32")
+            gb.append_op("relu", {"X": x}, {"Out": a})
+            gb.append_op("tanh", {"X": x}, {"Out": a})
+            return main, {"fetch_names": ["a"]}
+        if kind == "shape_mismatch":
+            x = fluid.layers.data("x", shape=[4])
+            y = gb.create_var(name="y", shape=[5], dtype="float32")
+            z = gb.create_var(name="z", shape=[-1, 4], dtype="float32")
+            gb.append_op("fill_constant", outputs={"Out": y},
+                         attrs={"shape": [5], "value": 1.0,
+                                "dtype": "float32"})
+            gb.append_op("elementwise_add", {"X": x, "Y": y}, {"Out": z},
+                         {"axis": -1})
+            return main, {"fetch_names": ["z"]}
+        if kind == "donated_fetch":
+            x = fluid.layers.data("x", shape=[4])
+            h = fluid.layers.fc(x, size=4)
+            w = main.all_parameters()[0]
+            return main, {"fetch_names": [h.name, w.name],
+                          "donate_state": True}
+    raise SystemExit("unknown defect kind %r" % kind)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="static program verifier over the paddle_tpu IR")
+    ap.add_argument("model_dir", nargs="?",
+                    help="saved inference model dir to verify")
+    ap.add_argument("--zoo", nargs="*", metavar="NAME",
+                    help="verify model-zoo programs (all when no names)")
+    ap.add_argument("--no-train", action="store_true",
+                    help="zoo: skip the optimizer/backward section")
+    ap.add_argument("--demo-defect",
+                    choices=["use_before_def", "double_write",
+                             "shape_mismatch", "donated_fetch"],
+                    help="build a known-bad program and show its diagnostic")
+    ap.add_argument("--hlo", metavar="FILE",
+                    help="compiled-HLO text to lint for sharding quality")
+    ap.add_argument("--require-sharded", nargs="*", default=(),
+                    metavar="VAR", help="HLO: state vars that must be "
+                    "actually sharded")
+    ap.add_argument("--param-shapes", metavar="JSON",
+                    help="HLO: JSON list of logical param shapes for the "
+                    "no-full-parameter-all-gather check")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    failed = False
+
+    def show(label, result):
+        nonlocal failed
+        n = len(result.diagnostics)
+        if n:
+            failed = True
+            print("%s: %d finding%s" % (label, n, "s" if n != 1 else ""))
+            for d in result.diagnostics:
+                print("  %s" % d)
+        elif not args.quiet:
+            print("%s: ok" % label)
+
+    if args.demo_defect:
+        program, kwargs = build_defective_program(args.demo_defect)
+        show("demo[%s]" % args.demo_defect,
+             analyze_program(program, **kwargs))
+
+    if args.hlo:
+        with open(args.hlo) as f:
+            hlo_text = f.read()
+        shapes = json.loads(args.param_shapes) if args.param_shapes else None
+        show("hlo[%s]" % args.hlo, analyze_hlo_sharding(
+            hlo_text, param_shapes=shapes,
+            require_sharded=args.require_sharded))
+
+    if args.zoo is not None:
+        builders = _zoo_builders()
+        names = args.zoo or sorted(builders)
+        unknown = [n for n in names if n not in builders]
+        if unknown:
+            raise SystemExit("unknown zoo model(s) %s; have %s"
+                             % (unknown, sorted(builders)))
+        for name in names:
+            res_main, res_startup = analyze_zoo_model(
+                builders[name], train=not args.no_train)
+            show("zoo[%s]" % name, res_main)
+            show("zoo[%s].startup" % name, res_startup)
+
+    if args.model_dir:
+        import pickle
+        import os
+
+        with open(os.path.join(args.model_dir, "__model__"), "rb") as f:
+            model = pickle.load(f)
+        show("model[%s]" % args.model_dir, analyze_program(
+            model["program"], feed_names=model["feed_names"],
+            fetch_names=model["fetch_names"]))
+
+    if (args.model_dir is None and args.zoo is None and not args.hlo
+            and not args.demo_defect):
+        ap.print_help()
+        return 2
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

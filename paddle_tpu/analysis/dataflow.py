@@ -1,0 +1,215 @@
+"""Dataflow core over the Program IR: def-use chains per op region.
+
+The reference's ParallelExecutor builds an SSA dependency graph over the
+ProgramDesc before execution (``details/multi_devices_graph_pass.cc``,
+``ssa_graph_builder.cc``) — every var version gets an explicit producing op,
+so hazards and dead nodes are structural properties. This module is the
+Python-IR analog: it turns a list of :class:`core.framework.Operator` into
+:class:`Region`/:class:`OpNode` objects carrying *effective* read/write
+name-sets, and recurses into control-flow bodies, which in this IR are
+op-list attrs (``cond_block.true_ops``/``false_ops``,
+``while_block.body_ops``, ``scan_block.step_ops``) rather than block-index
+attrs — the block structure exists for building, but execution and therefore
+analysis follow the attrs.
+
+Modeling decisions (shared by every pass built on top, and by
+``debugger.draw_block_graphviz``):
+
+  * Switch-guarded ops (``_switch_cond`` attr) are read-modify-write: the
+    runtime blends the new value with the prior one (``op_registry.run_op``),
+    so the op reads its own outputs and its guard cond. This is what orders
+    the per-case writes of an LR schedule.
+  * ``autodiff``/``autodiff_vjp`` do NOT recurse into ``fwd_ops`` — those
+    are the enclosing region's own ops (``backward.append_backward`` passes
+    the live op list), so recursing would double-count every forward op.
+    Their effective reads are the declared inputs plus ``wrt_names``; their
+    writes are the declared Grads/SparseRows only — the trace-time re-export
+    of replayed forward values is a CSE artifact, not a semantic write.
+  * Control-flow bodies run on a snapshot of the enclosing env, so a body's
+    free names (read before any body-local definition, and not bound by the
+    loop/scan carry contract) surface as reads of the enclosing op node.
+"""
+
+__all__ = ["OpNode", "Region", "build_region", "program_region",
+           "own_reads", "effective_reads", "effective_writes",
+           "SIDE_EFFECT_OPS"]
+
+# ops whose execution matters even when no output is consumed (host
+# callbacks, asserts, metric accumulation into persistable state)
+SIDE_EFFECT_OPS = frozenset({
+    "print", "py_func", "auc", "precision_recall", "detection_map",
+    "chunk_eval",
+})
+
+# op type -> list of (attr holding the sub op-list, fn(op) -> bound names).
+# "bound" names are defined at body entry by the op's own carry/scan
+# contract, so a body read of one is NOT a free (closure) read.
+_SUB_REGION_ATTRS = {
+    "cond_block": (("true_ops", lambda op: ()),
+                   ("false_ops", lambda op: ())),
+    "while_block": (("body_ops", lambda op: tuple(
+        [op.attr("cond_name")] if op.attr("cond_name") else [])
+        + tuple(v.name for v in op.input_list("Carry"))),),
+    "scan_block": (("step_ops", lambda op: tuple(
+        op.attr("x_step_names") or ()) + tuple(op.attr("carry_names") or ())),),
+}
+
+# symbolic ops whose attr-held op lists alias the enclosing region (never
+# recurse; see module docstring)
+_REPLAY_OPS = frozenset({"autodiff", "autodiff_vjp"})
+
+
+def own_reads(op, switch_rmw=True):
+    """Names ``op`` itself reads (control-flow body closures excluded).
+
+    ``switch_rmw=False`` drops a Switch-guarded op's self-read of its
+    outputs: the runtime blend only engages when the var already exists
+    (``op_registry.run_op``'s ``if n in env``), so a guarded op may
+    legitimately be its var's FIRST definition — the use-before-def check
+    wants that view, while ordering/drawing want the full RMW edge."""
+    reads = set(op.input_arg_names)
+    cond = op.attrs.get("_switch_cond")
+    if cond is not None:
+        reads.add(cond)
+        if switch_rmw:
+            reads.update(op.output_arg_names)  # prior values blended in
+    if op.type in _REPLAY_OPS:
+        reads.update(op.attr("wrt_names") or ())
+    if op.type == "while_block" and op.attr("cond_name"):
+        reads.add(op.attr("cond_name"))
+    return reads
+
+
+def effective_reads(op):
+    """Names ``op`` reads: :func:`own_reads` plus the free names of its
+    control-flow bodies (closure capture from the enclosing env)."""
+    reads = own_reads(op)
+    for attr, bound_fn in _SUB_REGION_ATTRS.get(op.type, ()):
+        sub_ops = op.attr(attr) or ()
+        reads.update(_free_reads(sub_ops, bound_fn(op)))
+    return reads
+
+
+def effective_writes(op):
+    """Names ``op`` defines in the enclosing region. Sub-region writes stay
+    local to the body (the control-flow op exports only its declared
+    outputs)."""
+    return set(op.output_arg_names)
+
+
+def _free_reads(ops, bound):
+    """Names read by ``ops`` before any local definition and not bound at
+    entry — the closure the body captures from the enclosing env."""
+    defined = set(bound)
+    free = set()
+    for op in ops:
+        free |= effective_reads(op) - defined
+        defined |= effective_writes(op)
+    return free
+
+
+class OpNode:
+    """One op within a Region, with its effective read/write sets and any
+    sub-regions (control-flow bodies)."""
+
+    def __init__(self, index, op):
+        self.index = index
+        self.op = op
+        self.reads = effective_reads(op)
+        self.writes = effective_writes(op)
+        # [(label, Region, bound names)]
+        self.subs = []
+
+    def __repr__(self):
+        return "OpNode(%d, %s)" % (self.index, self.op.type)
+
+
+class Region:
+    """An ordered op list analyzed as one sequential scope.
+
+    Provides the def-use structure every check consumes:
+      * ``writers``/``readers``: name -> ordered op indices
+      * ``raw_edges``: adjacency of true data dependencies (read-after-write,
+        each read depending on the latest prior writer) — the SSA-graph edge
+        set
+      * ``reaches(i, j)``: is there a dependency path from op i to op j?
+    """
+
+    def __init__(self, ops, name="global"):
+        self.name = name
+        self.nodes = [OpNode(i, op) for i, op in enumerate(ops)]
+        for node in self.nodes:
+            for attr, bound_fn in _SUB_REGION_ATTRS.get(node.op.type, ()):
+                sub_ops = node.op.attr(attr) or ()
+                if sub_ops:
+                    label = "%s/%s@%d.%s" % (self.name, node.op.type,
+                                             node.index, attr)
+                    node.subs.append((label, Region(sub_ops, name=label),
+                                      frozenset(bound_fn(node.op))))
+        self.writers = {}
+        self.readers = {}
+        for node in self.nodes:
+            for n in node.writes:
+                self.writers.setdefault(n, []).append(node.index)
+            for n in node.reads:
+                self.readers.setdefault(n, []).append(node.index)
+        self._adj = None
+        self._closure = None
+
+    @property
+    def ops(self):
+        return [node.op for node in self.nodes]
+
+    def raw_edges(self):
+        """Read-after-write adjacency: edges[i] = successor op indices that
+        read a value op i defined (latest-writer binding)."""
+        if self._adj is None:
+            adj = [set() for _ in self.nodes]
+            last_writer = {}
+            for node in self.nodes:
+                for n in node.reads:
+                    w = last_writer.get(n)
+                    if w is not None and w != node.index:
+                        adj[w].add(node.index)
+                for n in node.writes:
+                    last_writer[n] = node.index
+            self._adj = [sorted(s) for s in adj]
+        return self._adj
+
+    def reaches(self, src, dst):
+        """True iff a RAW dependency path leads from op ``src`` to ``dst``."""
+        if src == dst:
+            return True
+        adj = self.raw_edges()
+        seen = {src}
+        frontier = [src]
+        while frontier:
+            i = frontier.pop()
+            for j in adj[i]:
+                if j == dst:
+                    return True
+                if j not in seen and j < dst:  # edges only go forward
+                    seen.add(j)
+                    frontier.append(j)
+        return False
+
+    def walk(self):
+        """Yield (region, node) pairs for this region and all sub-regions,
+        outermost first."""
+        for node in self.nodes:
+            yield self, node
+            for _, sub, _ in node.subs:
+                yield from sub.walk()
+
+    def __repr__(self):
+        return "Region(%s, %d ops)" % (self.name, len(self.nodes))
+
+
+def build_region(ops, name="global"):
+    return Region(list(ops), name=name)
+
+
+def program_region(program):
+    """Dataflow region of the ops the executor actually runs: the global
+    block's op list (control-flow bodies hang off their ops' attrs)."""
+    return Region(list(program.global_block().ops), name="global")

@@ -10,8 +10,7 @@ follow the reference's ``<name>@GRAD`` convention so downstream code
 (clip, regularizer, optimizers, tests) composes identically.
 """
 
-from .core import framework
-from .core.framework import Parameter, Variable, grad_var_name
+from .core.framework import Variable, grad_var_name
 
 __all__ = ["append_backward", "calc_gradient", "gradients"]
 

@@ -21,7 +21,6 @@ slice indices and reassembled on load.
 import json
 import os
 import shutil
-import tempfile
 import threading
 import warnings
 

@@ -2,7 +2,6 @@
 GradientClipByValue/Norm/GlobalNorm, set_gradient_clip,
 append_gradient_clip_ops, ErrorClipByValue)."""
 
-from .core import framework
 from .core.framework import Parameter
 
 __all__ = ["GradientClipByValue", "GradientClipByNorm",

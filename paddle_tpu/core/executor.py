@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import framework
-from .framework import Program, Variable, convert_np_dtype
+from .framework import Variable
 from .op_registry import run_op, RNG_KEY, RNG0_KEY, ENV0_KEY
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard",
@@ -254,17 +254,31 @@ class Executor:
     def __init__(self, place=None):
         self.place = place if place is not None else XLAPlace(0)
         self._cache = {}
+        # program variants already verified -> strictness (1 = warn-mode,
+        # 2 = raising). A warn-mode pass must NOT suppress a later strict
+        # verify=True of the same variant.
+        self._verified = {}
 
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
             return_numpy=True, use_program_cache=True, feed_var_name="feed",
-            fetch_var_name="fetch", check_nan_inf=None, donate_state=True):
+            fetch_var_name="fetch", check_nan_inf=None, donate_state=True,
+            verify=None):
         """``donate_state=False`` compiles the step WITHOUT donating the
         state pytree (and, off-mesh, without echoing unwritten state back
         out). Donation invalidates the input weight arrays mid-call — fine
         for a single-threaded training loop that re-sets the scope right
         after, but a use-after-free race when predictor clones serve the
-        same scope from concurrent threads (``inference.py``/``serving``)."""
+        same scope from concurrent threads (``inference.py``/``serving``).
+
+        ``verify=True`` (or env ``PADDLE_TPU_VERIFY=1``) runs the static
+        program verifier (``paddle_tpu.analysis``) once per compiled
+        variant, BEFORE lowering: use-before-def, unordered double writes,
+        static shape/dtype propagation, dead-op lint, and — when the state
+        is donated — the fetch/donation alias check. Errors raise
+        :class:`analysis.VerificationError` naming the op and the user
+        line that created it; ``verify="warn"`` (or
+        ``PADDLE_TPU_VERIFY=warn``) downgrades errors to warnings."""
         from .compiler import CompiledProgram
 
         if program is None:
@@ -388,6 +402,22 @@ class Executor:
                state_in_names, id(scope), mesh, dp_axis, sp_axis, seq_feeds,
                pp, zero_state, grad_scale, donate_state)
         entry = self._cache.get(key) if use_program_cache else None
+        if verify is None:
+            mode = os.environ.get("PADDLE_TPU_VERIFY", "").strip().lower()
+            verify = "warn" if mode == "warn" else mode in (
+                "1", "true", "yes", "on", "raise")
+        # once per program variant AT this strictness, cache hit or not —
+        # an explicit verify=True after the variant compiled (or after a
+        # warn-mode pass) must still verify
+        strictness = 0 if not verify else (1 if verify == "warn" else 2)
+        if strictness > self._verified.get(key, 0):
+            from ..analysis import verify_program
+
+            verify_program(
+                program, feed_names=sorted(feed_arrays),
+                fetch_names=fetch_names, state_names=persist_names,
+                donate_state=donate_state, warn=(verify == "warn"))
+            self._verified[key] = strictness
         if entry is None:
             entry = self._compile(program, tuple(sorted(feed_arrays)),
                                   fetch_names, state_in_names, persist_names,
@@ -427,6 +457,7 @@ class Executor:
         """Parity with ``Executor::Close`` (``executor.cc:139``): release the
         compiled-program cache."""
         self._cache.clear()
+        self._verified.clear()
         self._last_call = None
 
     # -- debug run-mode -----------------------------------------------------

@@ -19,8 +19,8 @@ TPU-first design notes:
 """
 
 import contextlib
-import copy
-import re
+import os
+import sys
 
 import numpy as np
 
@@ -78,6 +78,47 @@ def convert_np_dtype(dtype):
 def grad_var_name(name):
     """Gradient variable naming convention (ref: framework ``@GRAD`` suffix)."""
     return name + "@GRAD"
+
+
+# ---------------------------------------------------------------------------
+# Op provenance. Every appended op records the USER code line that created it
+# (the reference stores an op_callstack attr on each OpDesc for the same
+# reason — ``operator.cc`` prints it on enforce failures). Frames inside the
+# framework's own graph-building machinery (core/, layers/, the optimizer /
+# backward / clip wrappers) are skipped, so a diagnostic for an op appended
+# by ``opt.minimize(loss)`` points at the minimize() call, not at
+# layer_helper internals. Frame-pointer walk only — no traceback object, no
+# linecache reads — so the capture is cheap enough to stay always-on.
+# ---------------------------------------------------------------------------
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_FRAMEWORK_PREFIXES = (os.path.join(_PKG_DIR, "core"),
+                       os.path.join(_PKG_DIR, "layers"))
+_FRAMEWORK_FILES = frozenset(
+    os.path.join(_PKG_DIR, f) for f in
+    ("backward.py", "optimizer.py", "clip.py", "regularizer.py", "amp.py"))
+
+
+def _is_framework_frame(filename):
+    return (filename in _FRAMEWORK_FILES
+            or filename.startswith(_FRAMEWORK_PREFIXES))
+
+
+def _user_callsite(skip=2):
+    """(filename, lineno, function) of the innermost non-framework frame."""
+    try:
+        f = sys._getframe(skip)
+    except ValueError:  # shallower stack than expected (C embedding)
+        return None
+    first = None
+    while f is not None:
+        fn = f.f_code.co_filename
+        if first is None:
+            first = (fn, f.f_lineno, f.f_code.co_name)
+        if not _is_framework_frame(fn):
+            return (fn, f.f_lineno, f.f_code.co_name)
+        f = f.f_back
+    return first  # pure-framework stack (internal tests): best effort
 
 
 def in_dygraph_mode():
@@ -232,6 +273,7 @@ class Operator:
         self.inputs = {}
         self.outputs = {}
         self.attrs = dict(attrs) if attrs else {}
+        self.callsite = None  # (file, line, function) set by Block.append_op
         if inputs:
             for slot, vs in inputs.items():
                 self.inputs[slot] = list(vs) if isinstance(vs, (list, tuple)) else [vs]
@@ -255,6 +297,14 @@ class Operator:
 
     def attr(self, name, default=None):
         return self.attrs.get(name, default)
+
+    def where(self):
+        """Human-readable creation site for diagnostics, e.g.
+        ``train.py:42 (in build_model)``; '<unknown>' when not captured."""
+        if not self.callsite:
+            return "<unknown>"
+        fn, line, func = self.callsite
+        return "%s:%d (in %s)" % (os.path.basename(fn), line, func)
 
     @property
     def input_arg_names(self):
@@ -326,6 +376,7 @@ class Block:
 
     def append_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
+        op.callsite = _user_callsite()
         self.ops.append(op)
         for vs in op.outputs.values():
             for v in vs:
@@ -335,6 +386,7 @@ class Block:
 
     def prepend_op(self, type, inputs=None, outputs=None, attrs=None):
         op = Operator(self, type, inputs, outputs, attrs)
+        op.callsite = _user_callsite()
         self.ops.insert(0, op)
         self.program._version += 1
         return op
@@ -465,6 +517,7 @@ class Program:
                     {s: map_vars(b.idx, vs) for s, vs in op.inputs.items()},
                     {s: map_vars(b.idx, vs) for s, vs in op.outputs.items()},
                     attrs)
+                nop.callsite = op.callsite  # provenance survives cloning
                 nb.ops.append(nop)
         p._is_test = for_test
         p._version = self._version

@@ -49,6 +49,26 @@ class LayerHelper:
             init = ConstantInitializer(0.0) if is_bias else XavierInitializer()
 
         main_block = self.main_program.global_block()
+        # parameter sharing (ref fluid semantics: reusing a ParamAttr name
+        # shares the weight): return the existing Parameter instead of
+        # re-creating it — re-creation also appended a DUPLICATE init op to
+        # the startup program per reuse, leaving N unordered random writes
+        # to one var (caught by analysis.check_double_writes on word2vec's
+        # shared embedding)
+        existing = main_block.vars.get(attr.name)
+        if isinstance(existing, Parameter):
+            if tuple(existing.shape) != tuple(shape):
+                raise ValueError(
+                    "parameter %r reused with shape %s but it exists with "
+                    "shape %s" % (attr.name, shape, existing.shape))
+            import numpy as _np
+
+            if existing.dtype != _np.dtype(
+                    framework.convert_np_dtype(dtype)):
+                raise ValueError(
+                    "parameter %r reused with dtype %s but it exists with "
+                    "dtype %s" % (attr.name, dtype, existing.dtype))
+            return existing
         kwargs = attr._to_kwargs()
         param = main_block.create_parameter(
             shape=shape, dtype=dtype, **kwargs)

@@ -43,6 +43,42 @@ def registered(name):
     return name in OP_IMPLS
 
 
+# ---------------------------------------------------------------------------
+# Static shape/dtype inference rules (the analog of the reference's per-op
+# ``OperatorWithKernel::InferShape``, which C++ ops run BEFORE the kernel —
+# ``framework/operator.h``). Each rule is ``rule(ctx, op)`` over an
+# ``analysis.passes.ShapeCtx``: read input shapes/dtypes via ``ctx.shape`` /
+# ``ctx.dtype`` (entries may be -1 = unknown/batch dim), bind outputs via
+# ``ctx.set``, and raise ``ShapeError`` for statically-infeasible inputs.
+# Rules live in ``core/opimpl/shape_rules.py``, registered alongside the
+# lowerings; ops without a rule are skipped by the propagation pass (their
+# declared output shapes are trusted).
+# ---------------------------------------------------------------------------
+
+SHAPE_RULES = {}
+
+
+class ShapeError(ValueError):
+    """A shape/dtype rule proved the op statically infeasible."""
+
+
+def register_shape(*names):
+    """Decorator: register a static infer-shape rule for op type(s)."""
+
+    def deco(fn):
+        for n in names:
+            if n in SHAPE_RULES:
+                raise ValueError("shape rule for %s registered twice" % n)
+            SHAPE_RULES[n] = fn
+        return fn
+
+    return deco
+
+
+def shape_rule(name):
+    return SHAPE_RULES.get(name)
+
+
 def env_flag(name):
     """gflags-style boolean env: '1'/'true'/'yes'/'on' (any case) = on."""
     import os
@@ -169,6 +205,46 @@ def bcast_y(x, y, axis):
     for i, s in enumerate(y.shape):
         new_shape[axis + i] = s
     return jnp.reshape(y, new_shape)
+
+
+def static_bcast_shape(xs, ys, axis=-1):
+    """Static-shape mirror of :func:`bcast_y` + numpy broadcasting, with
+    -1 as the unknown/batch wildcard. Returns the result shape tuple, or
+    None when either side is unknown; raises ValueError for shapes that
+    are statically infeasible. Shared by the layer builders (declared
+    output shapes) and the analysis shape-inference rules, so the two can
+    never disagree."""
+    if xs is None or ys is None:
+        return None
+    xs = tuple(-1 if (d is None or int(d) < 0) else int(d) for d in xs)
+    ys = tuple(-1 if (d is None or int(d) < 0) else int(d) for d in ys)
+    # y aligns into x's rank at `axis` (reference semantics)
+    if 0 < len(ys) < len(xs):
+        a = len(xs) - len(ys) if axis in (None, -1) else int(axis)
+        if a < 0 or a + len(ys) > len(xs):
+            raise ValueError(
+                "broadcast axis %d places y shape %s outside x shape %s"
+                % (a, list(ys), list(xs)))
+        ys = (1,) * a + ys + (1,) * (len(xs) - a - len(ys))
+    rank = max(len(xs), len(ys))
+    xs = (1,) * (rank - len(xs)) + xs
+    ys = (1,) * (rank - len(ys)) + ys
+    out = []
+    for dx, dy in zip(xs, ys):
+        if dx == 1:
+            out.append(dy)
+        elif dy == 1:
+            out.append(dx)
+        elif dx == -1 or dy == -1:
+            # one side unknown: assume the known side (numpy would demand
+            # equality or 1, and 1 was handled above)
+            out.append(dx if dy == -1 else dy)
+        elif dx == dy:
+            out.append(dx)
+        else:
+            raise ValueError("cannot broadcast shapes %s and %s"
+                             % (list(xs), list(ys)))
+    return tuple(out)
 
 
 # ---------------------------------------------------------------------------

@@ -17,3 +17,4 @@ from . import decode_ops  # noqa: F401
 from . import loss_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
+from . import shape_rules  # noqa: F401  (static InferShape rules)

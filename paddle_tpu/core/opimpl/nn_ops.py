@@ -10,7 +10,6 @@ and fuses the elementwise epilogues.
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..op_registry import register, get, put, next_rng
 

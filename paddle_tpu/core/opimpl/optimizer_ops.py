@@ -18,7 +18,6 @@ duplicates parked on an out-of-range sentinel row dropped by the scatter).
 """
 
 import jax.numpy as jnp
-import jax
 
 from ..op_registry import register, get, put, merge_sparse_rows
 

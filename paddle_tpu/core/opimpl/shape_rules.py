@@ -1,0 +1,700 @@
+"""Static infer-shape/dtype rules for the core op set.
+
+The analog of the per-op ``InferShape`` methods every reference operator
+implements (``paddle/fluid/operators/*_op.cc``, run by
+``OperatorWithKernel::RunImpl`` before the kernel): each rule derives the
+output shapes/dtypes of one op type from its inputs' static shapes and
+checks them against the declared output Variables — so a shape bug is
+reported at the op that created it (with the user's code line) instead of
+surfacing as an XLA trace error inside the jitted step.
+
+Registered via :func:`core.op_registry.register_shape`, alongside the
+lowerings. Rules receive an ``analysis.passes.ShapeCtx`` and the symbolic
+op; -1 dims are wildcards (the batch dim). Ops without a rule are skipped
+by the propagation pass — their declared output shapes are trusted. A rule
+raises :class:`core.op_registry.ShapeError` when the inputs are
+statically infeasible (e.g. a contraction-dim mismatch).
+"""
+
+import numpy as np
+
+from ..op_registry import register_shape, ShapeError, static_bcast_shape
+from ..framework import convert_np_dtype
+
+
+def _prod(dims):
+    out = 1
+    for d in dims:
+        if d == -1:
+            return -1
+        out *= int(d)
+    return out
+
+
+def _norm_axis(a, rank):
+    return a + rank if a < 0 else a
+
+
+# ---------------------------------------------------------------------------
+# elementwise / comparison / logical (reference elementwise_op.h broadcast)
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE = ("elementwise_add", "elementwise_sub", "elementwise_mul",
+                "elementwise_div", "elementwise_max", "elementwise_min",
+                "elementwise_pow", "elementwise_mod", "elementwise_floordiv")
+_COMPARE = ("less_than", "less_equal", "greater_than", "greater_equal",
+            "equal", "not_equal")
+_LOGICAL = ("logical_and", "logical_or", "logical_xor")
+
+
+def _binop_rule(bool_out):
+    def rule(ctx, op):
+        xv, yv = op.input("X"), op.input("Y")
+        xs, ys = ctx.shape(xv), ctx.shape(yv)
+        dtype = np.dtype(bool) if bool_out else ctx.dtype(xv)
+        if xs is None or ys is None:
+            ctx.set(op.output("Out"), None, dtype)
+            return
+        try:
+            out = static_bcast_shape(xs, ys, op.attr("axis", -1))
+        except ValueError as e:
+            raise ShapeError("%s (X='%s' %s, Y='%s' %s)" % (
+                e, xv.name, list(xs), yv.name, list(ys)))
+        ctx.set(op.output("Out"), out, dtype)
+    return rule
+
+
+for _n in _ELEMENTWISE:
+    register_shape(_n)(_binop_rule(bool_out=False))
+for _n in _COMPARE + _LOGICAL:
+    register_shape(_n)(_binop_rule(bool_out=True))
+
+
+@register_shape("logical_not")
+def _logical_not_shape(ctx, op):
+    ctx.set(op.output("Out"), ctx.shape(op.input("X")), np.dtype(bool))
+
+
+# ---------------------------------------------------------------------------
+# shape-preserving unaries (activations, scale, clip, dropout, softmax...)
+# ---------------------------------------------------------------------------
+
+_LIKE_X = (
+    # activation_op.cc table
+    "sigmoid", "logsigmoid", "exp", "tanh", "tanh_shrink", "sqrt", "rsqrt",
+    "abs", "ceil", "floor", "round", "cos", "sin", "reciprocal", "log",
+    "square", "softplus", "softsign", "relu", "sign", "erf",
+    "relu6", "leaky_relu", "elu", "gelu", "brelu", "stanh", "hard_sigmoid",
+    "hard_shrink", "soft_shrink", "thresholded_relu", "swish", "selu",
+    "prelu",
+    # shape-preserving tensor/nn ops
+    "scale", "clip", "softmax", "log_softmax", "label_smooth",
+    "sigmoid_cross_entropy_with_logits", "increment", "fill_zeros_like",
+    "square_error_cost", "assign",
+)
+
+
+def _like_x_rule(ctx, op):
+    ctx.set(op.output("Out"), ctx.shape(op.input("X")),
+            ctx.dtype(op.input("X")))
+
+
+for _n in _LIKE_X:
+    register_shape(_n)(_like_x_rule)
+
+
+@register_shape("dropout")
+def _dropout_shape(ctx, op):
+    xs, dt = ctx.shape(op.input("X")), ctx.dtype(op.input("X"))
+    ctx.set(op.output("Out"), xs, dt)
+    ctx.set(op.output("Mask"), xs, dt)
+
+
+@register_shape("cast")
+def _cast_shape(ctx, op):
+    ctx.set(op.output("Out"), ctx.shape(op.input("X")),
+            convert_np_dtype(op.attr("out_dtype")))
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+@register_shape("mean")
+def _mean_shape(ctx, op):
+    ctx.set(op.output("Out"), (), ctx.dtype(op.input("X")))
+
+
+def _reduce_rule(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    if xs is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    dim = op.attr("dim", [0])
+    keep = op.attr("keep_dim", False)
+    if op.attr("reduce_all", False) or dim is None:
+        out = tuple([1] * len(xs)) if keep else ()
+        ctx.set(op.output("Out"), out, dt)
+        return
+    axes = {_norm_axis(d, len(xs)) for d in dim}
+    bad = [a for a in axes if a < 0 or a >= len(xs)]
+    if bad:
+        raise ShapeError("reduce dim %s out of range for rank %d"
+                         % (sorted(bad), len(xs)))
+    out = tuple(1 if i in axes else d for i, d in enumerate(xs)) if keep \
+        else tuple(d for i, d in enumerate(xs) if i not in axes)
+    ctx.set(op.output("Out"), out, dt)
+
+
+for _n in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+           "reduce_prod"):
+    register_shape(_n)(_reduce_rule)
+
+
+@register_shape("sum")
+def _sum_shape(ctx, op):
+    vs = op.input_list("X")
+    shapes = [ctx.shape(v) for v in vs]
+    out = None
+    for v, s in zip(vs, shapes):
+        if s is None:
+            continue
+        if out is None:
+            out = s
+            continue
+        try:
+            out = static_bcast_shape(out, s, -1)
+        except ValueError:
+            raise ShapeError(
+                "sum inputs have incompatible shapes; '%s' is %s vs %s"
+                % (v.name, list(s), list(out)))
+    ctx.set(op.output("Out"), out, ctx.dtype(vs[0]) if vs else None)
+
+
+# ---------------------------------------------------------------------------
+# matmul family
+# ---------------------------------------------------------------------------
+
+@register_shape("mul")
+def _mul_shape(ctx, op):
+    xv, yv = op.input("X"), op.input("Y")
+    xs, ys = ctx.shape(xv), ctx.shape(yv)
+    if xs is None or ys is None:
+        ctx.set(op.output("Out"), None, ctx.dtype(xv))
+        return
+    xnc = op.attr("x_num_col_dims", 1)
+    ync = op.attr("y_num_col_dims", 1)
+    if not (0 < xnc < max(len(xs), 1) + 1 and 0 < ync < max(len(ys), 1) + 1):
+        raise ShapeError("num_col_dims (%d, %d) out of range for shapes "
+                         "%s, %s" % (xnc, ync, list(xs), list(ys)))
+    k1, k2 = _prod(xs[xnc:]), _prod(ys[:ync])
+    if k1 != -1 and k2 != -1 and k1 != k2:
+        raise ShapeError(
+            "contraction dims differ: X '%s' %s flattens to [*, %d] but "
+            "Y '%s' %s flattens to [%d, *]"
+            % (xv.name, list(xs), k1, yv.name, list(ys), k2))
+    ctx.set(op.output("Out"), tuple(xs[:xnc]) + tuple(ys[ync:]),
+            ctx.dtype(xv))
+
+
+@register_shape("matmul")
+def _matmul_shape(ctx, op):
+    xv, yv = op.input("X"), op.input("Y")
+    xs, ys = ctx.shape(xv), ctx.shape(yv)
+    if xs is None or ys is None or len(xs) < 2 or len(ys) < 2:
+        ctx.set(op.output("Out"), None, ctx.dtype(xv))
+        return
+    if op.attr("transpose_X", False):
+        xs = xs[:-2] + (xs[-1], xs[-2])
+    if op.attr("transpose_Y", False):
+        ys = ys[:-2] + (ys[-1], ys[-2])
+    if xs[-1] != -1 and ys[-2] != -1 and xs[-1] != ys[-2]:
+        raise ShapeError(
+            "matmul contraction mismatch: X '%s' ends in %d but Y '%s' "
+            "starts with %d (effective shapes %s x %s)"
+            % (xv.name, xs[-1], yv.name, ys[-2], list(xs), list(ys)))
+    try:
+        batch = static_bcast_shape(xs[:-2], ys[:-2], -1)
+    except ValueError:
+        raise ShapeError("matmul batch dims %s and %s do not broadcast"
+                         % (list(xs[:-2]), list(ys[:-2])))
+    ctx.set(op.output("Out"), tuple(batch) + (xs[-2], ys[-1]),
+            ctx.dtype(xv))
+
+
+# ---------------------------------------------------------------------------
+# tensor manipulation
+# ---------------------------------------------------------------------------
+
+@register_shape("concat")
+def _concat_shape(ctx, op):
+    vs = op.input_list("X")
+    shapes = [ctx.shape(v) for v in vs]
+    if any(s is None for s in shapes) or not shapes:
+        ctx.set(op.output("Out"), None, ctx.dtype(vs[0]) if vs else None)
+        return
+    rank = len(shapes[0])
+    if any(len(s) != rank for s in shapes):
+        raise ShapeError("concat inputs have mixed ranks: %s"
+                         % [list(s) for s in shapes])
+    axis = _norm_axis(op.attr("axis", 0), rank)
+    out = list(shapes[0])
+    total = 0
+    for s in shapes:
+        for i in range(rank):
+            if i == axis:
+                continue
+            if out[i] == -1:
+                out[i] = s[i]
+            elif s[i] != -1 and s[i] != out[i]:
+                raise ShapeError(
+                    "concat inputs disagree on non-concat dim %d: %s"
+                    % (i, [list(t) for t in shapes]))
+        total = -1 if (total == -1 or s[axis] == -1) else total + s[axis]
+    out[axis] = total
+    ctx.set(op.output("Out"), tuple(out), ctx.dtype(vs[0]))
+
+
+@register_shape("split")
+def _split_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    outs = op.output_list("Out")
+    if xs is None:
+        for v in outs:
+            ctx.set(v, None, dt)
+        return
+    axis = _norm_axis(op.attr("axis", 0), len(xs))
+    sections = op.attr("sections")
+    if sections:
+        if xs[axis] != -1 and sum(sections) != xs[axis]:
+            raise ShapeError("split sections %s do not sum to dim %d"
+                             % (sections, xs[axis]))
+        sizes = sections
+    else:
+        num = op.attr("num", 0) or len(outs)
+        if xs[axis] != -1 and xs[axis] % num != 0:
+            raise ShapeError("split num %d does not divide dim %d"
+                             % (num, xs[axis]))
+        sizes = [(-1 if xs[axis] == -1 else xs[axis] // num)] * num
+    for v, size in zip(outs, sizes):
+        ctx.set(v, xs[:axis] + (size,) + xs[axis + 1:], dt)
+
+
+@register_shape("reshape", "reshape2")
+def _reshape_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    shape = list(op.attr("shape") or ())
+    if xs is None or not shape:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:  # ref reshape_op: 0 copies the input dim
+            if i >= len(xs):
+                raise ShapeError("reshape dim %d copies input dim %d but "
+                                 "input rank is %d" % (i, i, len(xs)))
+            out.append(xs[i])
+        else:
+            out.append(int(s))
+    n_in = _prod(xs)
+    negs = [i for i, s in enumerate(out) if s == -1]
+    if len(negs) > 1:
+        raise ShapeError("reshape target %s has more than one -1" % (out,))
+    if negs:
+        rest = _prod([s for s in out if s != -1])
+        if n_in != -1 and rest > 0:
+            if n_in % rest != 0:
+                raise ShapeError(
+                    "cannot reshape %s (%d elements) into %s"
+                    % (list(xs), n_in, out))
+            out[negs[0]] = n_in // rest
+    elif n_in != -1:
+        if _prod(out) != n_in:
+            raise ShapeError("cannot reshape %s (%d elements) into %s "
+                             "(%d elements)" % (list(xs), n_in, out,
+                                                _prod(out)))
+    ctx.set(op.output("Out"), tuple(out), dt)
+
+
+@register_shape("squeeze", "squeeze2")
+def _squeeze_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    if xs is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    axes = op.attr("axes", [])
+    if axes:
+        axes = {_norm_axis(a, len(xs)) for a in axes}
+        for a in axes:
+            if xs[a] not in (1, -1):
+                raise ShapeError("squeeze axis %d has size %d (must be 1) "
+                                 "in %s" % (a, xs[a], list(xs)))
+        out = tuple(d for i, d in enumerate(xs) if i not in axes)
+    else:
+        out = tuple(d for d in xs if d != 1)
+    ctx.set(op.output("Out"), out, dt)
+
+
+@register_shape("unsqueeze", "unsqueeze2")
+def _unsqueeze_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    if xs is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    out = list(xs)
+    for a in sorted(op.attr("axes")):
+        out.insert(a if a >= 0 else a + len(out) + 1, 1)
+    ctx.set(op.output("Out"), tuple(out), dt)
+
+
+@register_shape("flatten", "flatten2")
+def _flatten_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    if xs is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    axis = op.attr("axis", 1)
+    lead = _prod(xs[:axis]) if axis > 0 else 1
+    trail = _prod(xs[axis:])
+    ctx.set(op.output("Out"), (lead, trail), dt)
+
+
+@register_shape("transpose", "transpose2")
+def _transpose_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    perm = op.attr("axis")
+    if xs is None or perm is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    if sorted(_norm_axis(a, len(xs)) for a in perm) != list(range(len(xs))):
+        raise ShapeError("transpose perm %s is not a permutation of rank %d"
+                         % (perm, len(xs)))
+    ctx.set(op.output("Out"),
+            tuple(xs[_norm_axis(a, len(xs))] for a in perm), dt)
+
+
+@register_shape("stack")
+def _stack_shape(ctx, op):
+    vs = op.input_list("X")
+    shapes = [ctx.shape(v) for v in vs]
+    if any(s is None for s in shapes) or not shapes:
+        ctx.set(op.output("Out") or op.output("Y"), None,
+                ctx.dtype(vs[0]) if vs else None)
+        return
+    base = shapes[0]
+    for s in shapes[1:]:
+        if len(s) != len(base) or any(
+                a != -1 and b != -1 and a != b for a, b in zip(s, base)):
+            raise ShapeError("stack inputs disagree: %s"
+                             % [list(t) for t in shapes])
+    axis = _norm_axis(op.attr("axis", 0), len(base) + 1)
+    out = base[:axis] + (len(vs),) + base[axis:]
+    ctx.set(op.output("Out") or op.output("Y"), out, ctx.dtype(vs[0]))
+
+
+@register_shape("slice")
+def _slice_shape(ctx, op):
+    xs = ctx.shape(op.input("Input"))
+    dt = ctx.dtype(op.input("Input"))
+    if xs is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    out = list(xs)
+    for a, s, e in zip(op.attr("axes"), op.attr("starts"), op.attr("ends")):
+        a = _norm_axis(a, len(xs))
+        d = xs[a]
+        if d == -1:
+            out[a] = (e - s) if (s >= 0 and 0 <= e < 10 ** 6) else -1
+            continue
+        s2 = min(d, s + d if s < 0 else s)
+        e2 = min(d, e + d if e < 0 else e)
+        out[a] = max(0, e2 - s2)
+    ctx.set(op.output("Out"), tuple(out), dt)
+
+
+@register_shape("gather")
+def _gather_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    idx = ctx.shape(op.input("Index"))
+    dt = ctx.dtype(op.input("X"))
+    if xs is None or idx is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    # the lowering flattens the index to 1-D (jnp.take along axis 0)
+    ctx.set(op.output("Out"), (_prod(idx),) + tuple(xs[1:]), dt)
+
+
+@register_shape("expand")
+def _expand_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    times = op.attr("expand_times")
+    if xs is None or times is None:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    if len(times) != len(xs):
+        raise ShapeError("expand_times %s rank != input rank %d"
+                         % (times, len(xs)))
+    ctx.set(op.output("Out"),
+            tuple(-1 if d == -1 else d * t for d, t in zip(xs, times)), dt)
+
+
+@register_shape("shape")
+def _shape_shape(ctx, op):
+    xs = ctx.shape(op.input("X") or op.input("Input"))
+    ctx.set(op.output("Out"), (len(xs),) if xs is not None else None,
+            np.dtype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# fills / random
+# ---------------------------------------------------------------------------
+
+def _attr_shape_rule(ctx, op):
+    shape = op.attr("shape")
+    ctx.set(op.output("Out"),
+            tuple(int(s) for s in shape) if shape is not None else None,
+            convert_np_dtype(op.attr("dtype", "float32")))
+
+
+for _n in ("fill_constant", "uniform_random", "gaussian_random",
+           "truncated_gaussian_random"):
+    register_shape(_n)(_attr_shape_rule)
+
+
+def _batch_size_like_rule(ctx, op):
+    ref = op.input("Input") or op.input("X")
+    rs = ctx.shape(ref)
+    shape = op.attr("shape")
+    if shape is None:
+        ctx.set(op.output("Out"), None, None)
+        return
+    out = [int(s) for s in shape]
+    in_idx = op.attr("input_dim_idx", 0)
+    out_idx = op.attr("output_dim_idx", 0)
+    if rs is not None and 0 <= in_idx < len(rs) and 0 <= out_idx < len(out):
+        out[out_idx] = rs[in_idx]
+    ctx.set(op.output("Out"), tuple(out),
+            convert_np_dtype(op.attr("dtype", "float32")))
+
+
+for _n in ("fill_constant_batch_size_like", "uniform_random_batch_size_like",
+           "gaussian_random_batch_size_like"):
+    register_shape(_n)(_batch_size_like_rule)
+
+
+# ---------------------------------------------------------------------------
+# embedding / indexing
+# ---------------------------------------------------------------------------
+
+def _ids_shape(ids):
+    """Lowerings squeeze a trailing [.., 1] ids dim (LoD-era convention)."""
+    if ids is not None and len(ids) >= 2 and ids[-1] == 1:
+        return ids[:-1]
+    return ids
+
+
+@register_shape("lookup_table")
+def _lookup_table_shape(ctx, op):
+    ws = ctx.shape(op.input("W"))
+    ids = _ids_shape(ctx.shape(op.input("Ids")))
+    if ws is None or ids is None:
+        ctx.set(op.output("Out"), None, ctx.dtype(op.input("W")))
+        return
+    if len(ws) != 2:
+        raise ShapeError("lookup_table W '%s' must be 2-D, got %s"
+                         % (op.input("W").name, list(ws)))
+    ctx.set(op.output("Out"), tuple(ids) + (ws[1],),
+            ctx.dtype(op.input("W")))
+
+
+@register_shape("one_hot")
+def _one_hot_shape(ctx, op):
+    ids = _ids_shape(ctx.shape(op.input("X")))
+    depth = op.attr("depth")
+    if ids is None or depth is None:
+        ctx.set(op.output("Out"), None, np.dtype(np.float32))
+        return
+    ctx.set(op.output("Out"), tuple(ids) + (int(depth),),
+            np.dtype(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# conv / pool / norm
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 2
+
+
+def _conv_dim(size, k, pad, stride, dil):
+    if size == -1:
+        return -1
+    eff = dil * (k - 1) + 1
+    out = (size + 2 * pad - eff) // stride + 1
+    if out <= 0:
+        raise ShapeError(
+            "conv/pool window (k=%d, pad=%d, stride=%d, dilation=%d) does "
+            "not fit input dim %d" % (k, pad, stride, dil, size))
+    return out
+
+
+@register_shape("conv2d", "depthwise_conv2d")
+def _conv2d_shape(ctx, op):
+    xs = ctx.shape(op.input("Input"))
+    ws = ctx.shape(op.input("Filter"))
+    dt = ctx.dtype(op.input("Input"))
+    if xs is None or ws is None or len(xs) != 4 or len(ws) != 4:
+        ctx.set(op.output("Output"), None, dt)
+        return
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    if op.type == "depthwise_conv2d" and xs[1] != -1:
+        groups = xs[1]
+    if xs[1] != -1 and ws[1] != -1 and ws[1] * groups != xs[1]:
+        raise ShapeError(
+            "in-channels mismatch: input '%s' has C=%d but filter '%s' is "
+            "%s with groups=%d (needs C = %d)"
+            % (op.input("Input").name, xs[1], op.input("Filter").name,
+               list(ws), groups, ws[1] * groups))
+    oh = _conv_dim(xs[2], ws[2], pads[0], strides[0], dil[0])
+    ow = _conv_dim(xs[3], ws[3], pads[1], strides[1], dil[1])
+    ctx.set(op.output("Output"), (xs[0], ws[0], oh, ow), dt)
+
+
+@register_shape("pool2d")
+def _pool2d_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    if xs is None or len(xs) != 4:
+        ctx.set(op.output("Out"), None, dt)
+        return
+    ksize = _pair(op.attr("ksize"))
+    if op.attr("global_pooling", False) or (
+            op.attr("adaptive", False) and ksize == (1, 1)):
+        ctx.set(op.output("Out"), (xs[0], xs[1], 1, 1), dt)
+        return
+    if op.attr("adaptive", False):
+        ctx.set(op.output("Out"), (xs[0], xs[1]) + ksize, dt)
+        return
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    ceil_mode = op.attr("ceil_mode", False)
+
+    def dim(size, k, pad, stride):
+        if size == -1:
+            return -1
+        if ceil_mode:
+            return -(-(size + 2 * pad - k) // stride) + 1
+        return (size + 2 * pad - k) // stride + 1
+
+    ctx.set(op.output("Out"),
+            (xs[0], xs[1], dim(xs[2], ksize[0], pads[0], strides[0]),
+             dim(xs[3], ksize[1], pads[1], strides[1])), dt)
+
+
+@register_shape("batch_norm")
+def _batch_norm_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("X"))
+    ctx.set(op.output("Y"), xs, dt)
+    if xs is None:
+        return
+    layout = op.attr("data_layout", "NCHW")
+    c = xs[1 if layout == "NCHW" else -1]
+    for slot in ("Scale", "Bias", "Mean", "Variance"):
+        v = op.input(slot)
+        s = ctx.shape(v)
+        if v is not None and s is not None and c != -1 and \
+                tuple(s) != (c,):
+            raise ShapeError(
+                "batch_norm %s '%s' has shape %s but the channel dim is %d"
+                % (slot, v.name, list(s), c))
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        ctx.set(op.output(slot), (c,) if c != -1 else None, None)
+
+
+@register_shape("layer_norm")
+def _layer_norm_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    ctx.set(op.output("Y"), xs, ctx.dtype(op.input("X")))
+    sv = op.input("Scale")
+    ss = ctx.shape(sv)
+    begin = op.attr("begin_norm_axis", 1)
+    if xs is not None and ss is not None and len(ss) == 1:
+        norm = _prod(xs[begin:])
+        if norm != -1 and ss[0] != -1 and ss[0] != norm:
+            raise ShapeError(
+                "layer_norm Scale '%s' has %d elements but the normalized "
+                "slice of %s has %d" % (sv.name, ss[0], list(xs), norm))
+
+
+@register_shape("group_norm")
+def _group_norm_shape(ctx, op):
+    ctx.set(op.output("Y"), ctx.shape(op.input("X")),
+            ctx.dtype(op.input("X")))
+
+
+# ---------------------------------------------------------------------------
+# losses / metrics / search
+# ---------------------------------------------------------------------------
+
+@register_shape("cross_entropy")
+def _cross_entropy_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    if xs is None:
+        ctx.set(op.output("Y"), None, ctx.dtype(op.input("X")))
+        return
+    ctx.set(op.output("Y"), tuple(xs[:-1]) + (1,), ctx.dtype(op.input("X")))
+
+
+@register_shape("softmax_with_cross_entropy")
+def _swce_shape(ctx, op):
+    xs = ctx.shape(op.input("Logits"))
+    dt = ctx.dtype(op.input("Logits"))
+    if xs is None:
+        ctx.set(op.output("Loss"), None, dt)
+        return
+    ctx.set(op.output("Loss"), tuple(xs[:-1]) + (1,), dt)
+    ctx.set(op.output("Softmax"), xs, dt)
+
+
+@register_shape("accuracy")
+def _accuracy_shape(ctx, op):
+    ctx.set(op.output("Accuracy"), (), np.dtype(np.float32))
+    ctx.set(op.output("Correct"), (1,), np.dtype(np.int32))
+    ctx.set(op.output("Total"), (1,), np.dtype(np.int32))
+
+
+@register_shape("top_k")
+def _top_k_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    k = int(op.attr("k", 1))
+    if xs is None:
+        return
+    if xs[-1] != -1 and k > xs[-1]:
+        raise ShapeError("top_k k=%d exceeds last dim of %s" % (k, list(xs)))
+    out = tuple(xs[:-1]) + (k,)
+    ctx.set(op.output("Out"), out, ctx.dtype(op.input("X")))
+    ctx.set(op.output("Indices"), out, np.dtype(np.int64))
+
+
+@register_shape("argmax", "argmin")
+def _arg_shape(ctx, op):
+    xs = ctx.shape(op.input("X"))
+    if xs is None:
+        ctx.set(op.output("Out"), None, np.dtype(np.int64))
+        return
+    axis = _norm_axis(op.attr("axis", -1), len(xs))
+    ctx.set(op.output("Out"), xs[:axis] + xs[axis + 1:], np.dtype(np.int64))

@@ -5,8 +5,6 @@ ragged sequence slots and emitting companion ``<name>_len`` length tensors
 
 import numpy as np
 
-from ..core.framework import Variable
-
 __all__ = ["DataFeeder"]
 
 

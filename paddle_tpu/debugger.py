@@ -1,7 +1,13 @@
 """Program visualization (ref ``python/paddle/fluid/debugger.py:222``
 ``draw_block_graphviz`` + ``graphviz.py``): dump a Block as a Graphviz
 .dot file — op nodes (boxes), var nodes (ellipses), dataflow edges.
-Pure-text emission; render with any dot binary or viewer."""
+Pure-text emission; render with any dot binary or viewer.
+
+Edges come from the ``analysis.dataflow`` core — the same effective
+read/write sets the verifier checks — so the drawing shows what actually
+flows: Switch-guarded ops show their hidden guard/prior-value reads,
+autodiff shows its ``wrt_names`` reads, and control-flow bodies
+(``while``/``cond``/``scan``) render as subgraph clusters."""
 
 __all__ = ["draw_block_graphviz", "pprint_program_codes"]
 
@@ -13,8 +19,15 @@ def _esc(s):
 def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
     """Write ``block``'s dataflow graph to ``path`` (DOT format).
     ``highlights``: iterable of var names to fill red."""
+    from .analysis.dataflow import build_region
+
     highlights = set(highlights or ())
-    lines = ["digraph G {", "  rankdir=TB;"]
+    # var-node DEFINITIONS go to the graph root, separate from the
+    # per-region op/edge lines: a statement's position decides Graphviz
+    # cluster membership, so defining a var at first use inside a body
+    # cluster would misdraw enclosing-scope vars as body-local
+    var_lines = []
+    lines = []
     var_ids = {}
 
     def var_node(name):
@@ -29,21 +42,34 @@ def draw_block_graphviz(block, highlights=None, path="./temp.dot"):
                                    getattr(v, "dtype", ""))
         style = ', style=filled, fillcolor="red"' if name in highlights \
             else ""
-        lines.append('  %s [label="%s", shape=ellipse%s];'
-                     % (nid, _esc(label), style))
+        var_lines.append('  %s [label="%s", shape=ellipse%s];'
+                         % (nid, _esc(label), style))
         return nid
 
-    for i, op in enumerate(block.ops):
-        op_id = "op_%d" % i
-        lines.append('  %s [label="%s", shape=box, style=filled, '
-                     'fillcolor="lightgray"];' % (op_id, _esc(op.type)))
-        for name in op.input_arg_names:
-            lines.append("  %s -> %s;" % (var_node(name), op_id))
-        for name in op.output_arg_names:
-            lines.append("  %s -> %s;" % (op_id, var_node(name)))
-    lines.append("}")
+    n_ops = 0
+
+    def emit_region(region, indent="  "):
+        nonlocal n_ops
+        for node in region.nodes:
+            op_id = "op_%d" % n_ops
+            n_ops += 1
+            lines.append('%s%s [label="%s", shape=box, style=filled, '
+                         'fillcolor="lightgray"];'
+                         % (indent, op_id, _esc(node.op.type)))
+            for name in sorted(node.reads):
+                lines.append("%s%s -> %s;" % (indent, var_node(name), op_id))
+            for name in sorted(node.writes):
+                lines.append("%s%s -> %s;" % (indent, op_id, var_node(name)))
+            for label, sub, _ in node.subs:
+                lines.append("%ssubgraph cluster_%d {" % (indent, n_ops))
+                lines.append('%s  label="%s";' % (indent, _esc(label)))
+                emit_region(sub, indent + "  ")
+                lines.append("%s}" % indent)
+
+    emit_region(build_region(block.ops, name="block%d" % block.idx))
+    out = (["digraph G {", "  rankdir=TB;"] + var_lines + lines + ["}"])
     with open(path, "w") as f:
-        f.write("\n".join(lines) + "\n")
+        f.write("\n".join(out) + "\n")
     return path
 
 

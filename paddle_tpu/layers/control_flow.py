@@ -8,7 +8,6 @@ recursion into sub-BlockDescs.
 """
 
 from ..core import framework
-from ..core.framework import Variable
 from ..core.layer_helper import LayerHelper
 
 __all__ = ["StaticRNN", "DynamicRNN", "While", "Switch", "cond", "increment",

@@ -4,7 +4,6 @@ declares a feed slot; ``py_reader`` is provided by the data pipeline
 feeds the executor (double-buffered device puts replace the reference's
 ``create_double_buffer_reader_op``)."""
 
-from ..core import framework
 from ..core.layer_helper import LayerHelper
 
 __all__ = ["data"]

@@ -1,7 +1,6 @@
 """Operator-overload sugar for Variables (ref
 ``python/paddle/fluid/layers/math_op_patch.py`` monkey_patch_variable)."""
 
-import numpy as np
 
 from ..core.framework import Variable
 
@@ -17,10 +16,17 @@ def binary(x, other, op_type, reverse=False, out=None):
         val = float(other)
         other = tensor.fill_constant(
             shape=[1], dtype=str(x.dtype), value=val)
+    from ..core.op_registry import static_bcast_shape
+
     a, b = (other, x) if reverse else (x, other)
     a_shape = a.shape or ()
     b_shape = b.shape or ()
-    out_shape = a_shape if len(a_shape) >= len(b_shape) else b_shape
+    try:
+        out_shape = static_bcast_shape(a_shape, b_shape, -1)
+    except ValueError:
+        # infeasible operands: keep a's shape; the analysis shape pass
+        # reports the contradiction with the op's creation site
+        out_shape = a_shape
     dtype = "bool" if op_type in _CMP else str(a.dtype)
     if out is None:
         out = block.create_var(shape=out_shape, dtype=dtype)

@@ -25,7 +25,6 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         slide_steps=1):
     """Streaming AUC with persistable histogram state (ref auc_op)."""
     helper = LayerHelper("auc")
-    from ..core import framework
     from . import tensor
 
     stat_pos = tensor.create_global_var(

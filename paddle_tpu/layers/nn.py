@@ -8,7 +8,6 @@ layer definitions for parity checking.
 
 import numpy as np
 
-from ..core.framework import Variable, convert_np_dtype
 from ..core.layer_helper import LayerHelper
 from ..core.initializer import (ConstantInitializer, NormalInitializer,
                                 UniformInitializer, XavierInitializer)
@@ -406,7 +405,8 @@ def _unary_layer(op_type, x, attrs=None, name=None, out_shape=None,
                  out_dtype=None):
     helper = LayerHelper(op_type, name=name)
     out = helper.create_variable_for_type_inference(
-        dtype=out_dtype or _dtype(x), shape=out_shape or x.shape)
+        dtype=out_dtype or _dtype(x),
+        shape=x.shape if out_shape is None else out_shape)
     helper.append_op(op_type, {"X": x}, {"Out": out}, attrs or {})
     return out
 
@@ -512,8 +512,15 @@ def mean(x, name=None):
 
 
 def _elementwise(op_type, x, y, axis=-1, act=None, name=None):
+    from ..core.op_registry import static_bcast_shape
+
     helper = LayerHelper(op_type, act=act, name=name)
-    out_shape = x.shape if len(x.shape or ()) >= len(y.shape or ()) else y.shape
+    try:
+        out_shape = static_bcast_shape(x.shape, y.shape, axis)
+    except ValueError:
+        # statically infeasible: declare x's shape and let the analysis
+        # shape pass report the mismatch with provenance
+        out_shape = x.shape
     out = helper.create_variable_for_type_inference(dtype=_dtype(x),
                                                     shape=out_shape)
     helper.append_op(op_type, {"X": x, "Y": y}, {"Out": out}, {"axis": axis})

@@ -9,13 +9,11 @@ exports the pure ``apply(params, key, *feeds) -> loss`` that jits into the
 identical XLA step (parity-tested against ``models/bert.py`` in
 ``tests/test_dygraph_bert.py``)."""
 
-import math
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .. import dygraph
 from ..dygraph import nn as dnn
 from ..dygraph.base import VarBase, record, to_variable
 from ..dygraph.layers import Layer

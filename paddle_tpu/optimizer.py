@@ -8,13 +8,10 @@ TPU. Accumulators (velocity/moments/...) are persistable vars initialized by
 the startup program, mirroring the reference's ``_add_accumulator``.
 """
 
-import numpy as np
-
 from .backward import append_backward
 from .core import framework, unique_name
 from .core.framework import Variable, Parameter
-from .core.layer_helper import LayerHelper
-from .clip import append_gradient_clip_ops, ErrorClipByValue
+from .clip import append_gradient_clip_ops
 from .regularizer import append_regularization_ops
 
 __all__ = [

@@ -12,7 +12,7 @@ import contextlib
 import numpy as np
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh
 
 __all__ = ["make_mesh", "get_mesh", "set_mesh", "mesh_scope", "DistStrategy"]
 

@@ -7,7 +7,6 @@ partial results for its local Q block. Communication rides ICI and overlaps
 with the per-block attention compute.
 """
 
-import functools
 import math
 
 import jax

@@ -2,7 +2,6 @@
 append grad-modification ops ``grad += coeff * penalty'(param)`` before the
 optimizer update, honoring per-param ``ParamAttr.regularizer`` overrides."""
 
-from .core.framework import Parameter
 
 __all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer",
            "append_regularization_ops"]

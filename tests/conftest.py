@@ -27,7 +27,6 @@ import pytest  # noqa: E402
 def fresh_programs():
     """Give every test fresh default programs + scope + name generator
     (tests build graphs into module-level singletons)."""
-    import paddle_tpu as fluid
     from paddle_tpu.core import framework, unique_name
     from paddle_tpu.core import executor as executor_mod
 

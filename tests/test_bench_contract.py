@@ -14,7 +14,7 @@ def _specs(monkeypatch):
         main, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main, startup):
             fluid.unique_name.switch()
-            spec, batch, metric, unit, per_example = bench._build(
+            spec, batch, metric, unit, per_example, _seq = bench._build(
                 model, on_tpu=False)
         out[model] = (spec, batch, metric, unit, per_example)
     return out
@@ -45,6 +45,11 @@ def test_serving_bench_record(monkeypatch):
     assert rec["unit"] == "requests/sec"
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
+    # self-describing record (ROADMAP item 5): the knobs that shaped the
+    # number ride in the line
+    assert rec["config"]["clients"] == 2
+    assert rec["config"]["replicas"] == 1
+    assert rec["config"]["p99_budget_s"] > 0
 
 
 def test_seq_override_metric_suffix(monkeypatch):
@@ -54,6 +59,28 @@ def test_seq_override_metric_suffix(monkeypatch):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         fluid.unique_name.switch()
-        _, _, metric, _, _ = bench._build("transformer", on_tpu=False,
-                                          seq_override=128)
+        _, _, metric, _, _, seq = bench._build("transformer", on_tpu=False,
+                                               seq_override=128)
     assert metric == "transformer_base_seq128_tokens_per_sec_per_chip"
+    assert seq == 128
+
+
+def test_batch_rounding_warns(monkeypatch):
+    """The transformer token-budget batch auto-scale must WARN when it
+    rounds (ROADMAP item 5 standing bug: it used to round silently,
+    making vs_baseline numbers non-re-derivable across seq lengths)."""
+    import warnings
+
+    import bench
+
+    monkeypatch.delenv("BENCH_SEQ", raising=False)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.unique_name.switch()
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            # 1000 does not divide the 32768-token budget -> rounds
+            bench._build("transformer", on_tpu=True, seq_override=1000)
+    msgs = [str(w.message) for w in caught
+            if issubclass(w.category, RuntimeWarning)]
+    assert any("ROUNDED DOWN" in m for m in msgs), msgs

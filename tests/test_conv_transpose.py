@@ -61,7 +61,6 @@ def test_dygraph_conv2d_transpose():
 def test_dygraph_spectral_norm_constant_uv_grad():
     """dW must treat sigma's u, v as constants (ref spectral_norm_op), and
     u/v must not appear among trainable parameters."""
-    import jax
     import jax.numpy as jnp
     from paddle_tpu.dygraph import nn as dnn
 

@@ -4,7 +4,6 @@ test_yolov3_loss_op, test_generate_proposals...). Fixed-shape outputs with
 pad marker -1 + counts replace the reference's LoD outputs."""
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from op_test import check_output

@@ -2,11 +2,9 @@
 §5.3): env protocol, fate-sharing, resume_or_init / AutoCheckpoint."""
 
 import os
-import sys
 import textwrap
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.distributed.launch import launch

@@ -2,7 +2,6 @@
 (``tests/book/``: build model, train a few steps, assert loss decreases)."""
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu import models

@@ -1,7 +1,6 @@
 """Per-op forward tests vs numpy references (ref test strategy §4.1)."""
 
 import numpy as np
-import pytest
 
 from op_test import check_output
 

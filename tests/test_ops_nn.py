@@ -1,7 +1,6 @@
 """NN op forward tests vs numpy references."""
 
 import numpy as np
-import pytest
 
 from op_test import check_output
 

@@ -3,7 +3,6 @@
 export, magnitude pruning, distillation loss."""
 
 import numpy as np
-import pytest
 
 import paddle_tpu as fluid
 from paddle_tpu.contrib.quantize import QuantizeTranspiler

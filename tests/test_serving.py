@@ -9,8 +9,6 @@ import time
 import numpy as np
 import pytest
 
-import paddle_tpu as fluid
-from paddle_tpu import serving
 from paddle_tpu.serving import (BucketError, DeadlineExceededError,
                                 DynamicBatcher, Request, ServingEngine,
                                 ServerOverloadedError, bucket_for,

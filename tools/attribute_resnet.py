@@ -50,7 +50,7 @@ def capture(steps, batch):
     on_tpu = jax.devices()[0].platform == "tpu"
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
-        spec, dbatch, _, _, _ = _build("resnet50", on_tpu)
+        spec, dbatch, _, _, _, _ = _build("resnet50", on_tpu)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
         if os.environ.get("BENCH_AMP", "1") == "1":
             opt = fluid.amp.decorate(opt)
@@ -262,7 +262,7 @@ def main():
         # rebuild the program for floors only
         main_prog, startup = fluid.Program(), fluid.Program()
         with fluid.program_guard(main_prog, startup):
-            spec, dbatch, _, _, _ = _build("resnet50", True)
+            spec, dbatch, _, _, _, _ = _build("resnet50", True)
             fluid.optimizer.Adam(learning_rate=1e-4).minimize(spec.loss)
     else:
         main_prog, batch = capture(args.steps, args.batch)

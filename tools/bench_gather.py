@@ -16,7 +16,6 @@ Timing: slope method (chained fori_loop at 2 lengths), f32-scalar sync
 """
 import sys
 import time
-import functools
 
 import numpy as np
 import jax

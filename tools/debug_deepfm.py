@@ -35,7 +35,6 @@ def main():
         with fluid.program_guard(main_prog, startup):
             if mode == "dense":
                 import paddle_tpu.layers as layers
-                from paddle_tpu.models import deepfm as dfm_mod
                 orig = layers.embedding
 
                 def emb_dense(*a, **kw):

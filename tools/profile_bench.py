@@ -7,7 +7,6 @@ Writes the raw trace under /tmp/jaxtrace-<model> and prints a table.
 
 import argparse
 import glob
-import gzip
 import json
 import os
 import sys
@@ -26,7 +25,8 @@ def capture(model, steps, batch=None):
     on_tpu = jax.devices()[0].platform == "tpu"
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
-        spec, dbatch, metric, unit, per_example = _build(model, on_tpu)
+        spec, dbatch, metric, unit, per_example, _seq = _build(
+            model, on_tpu)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
         if os.environ.get("BENCH_AMP", "1") == "1":
             opt = fluid.amp.decorate(opt)
