@@ -269,7 +269,16 @@ def _bench_serving(on_tpu):
                        "replicas": replicas,
                        "max_batch_size": max_batch_size,
                        "max_wait_ms": max_wait_ms,
-                       "p99_budget_s": p99_budget_s}}
+                       "p99_budget_s": p99_budget_s},
+            # self-healing event counters ride in the line: a healthy run
+            # has all zeros, so a nonzero here flags that the throughput
+            # number was earned under degradation (retries/evictions/EDF
+            # shedding) and is not comparable to a clean baseline
+            "reliability": {
+                "requests_shed": m["requests_shed"],
+                "requests_retried": m["requests_retried"],
+                "replicas_evicted": m["replicas_evicted"],
+                "workers_respawned": m["workers_respawned"]}}
 
 
 def _bench_bert_dygraph(on_tpu):

@@ -13,11 +13,14 @@ host batching overlaps device compute). Thread-per-graph execution would
 only fragment the TPU; thread_num instead scales the file readers.
 """
 
+import warnings
+
 import numpy as np
 
 from . import native
 from .core import framework
 from .core.executor import Executor, global_scope
+from .reliability import faults
 
 __all__ = ["AsyncExecutor"]
 
@@ -29,14 +32,21 @@ class AsyncExecutor:
 
     def run(self, program, data_feed, filelist, thread_num=2,
             fetch=None, mode="", debug=False, n_epochs=1, scope=None,
-            queue_capacity=1024):
+            queue_capacity=1024, max_bad_records=0):
         """Train ``program`` over every sample in ``filelist`` (recordio
         files of ``data_feed``-serialized samples). Returns the list of
         fetch values from the last step.
 
         ``thread_num`` = native reader threads (ref: worker thread count).
         Partial final batches are dropped, matching the fixed-shape batch
-        convention (and the reference's DataFeed batch semantics)."""
+        convention (and the reference's DataFeed batch semantics).
+
+        ``max_bad_records``: a long-running ingest job must not die to one
+        torn record (the reference's recordio chunk-CRC skip-on-corrupt,
+        SURVEY §5.3) — records whose size does not match the
+        ``data_feed`` schema are skipped and counted, up to this bound;
+        one past it aborts the run. 0 (default) keeps fail-fast; ``None``
+        is unbounded (counted + warned only)."""
         program = program or framework.default_main_program()
         fetch = fetch or []
         if isinstance(filelist, str):
@@ -44,15 +54,33 @@ class AsyncExecutor:
         if not native.native_available():
             raise RuntimeError("AsyncExecutor needs the native data plane "
                                "(g++ toolchain) — use PyReader instead")
+        faults.maybe_install_from_env()
         scope = scope or global_scope()
         fetch_vals = None
         bs = data_feed.batch_size
+        rec_nbytes = getattr(data_feed, "sample_nbytes", None)
         steps = 0
+        bad = 0
         with native.PrefetchQueue(capacity=queue_capacity) as q:
             q.start_files(list(filelist), n_threads=int(thread_num),
                           n_epochs=int(n_epochs))
             batch = []
             for rec in q:
+                # fault site: 'corrupt' truncates the record (drilling the
+                # bounded-skip path below); 'error'/'hang' model a dying
+                # or stalling reader
+                if faults.trip("recordio.read") == "corrupt":
+                    rec = faults.corrupt_bytes(rec)
+                if rec_nbytes is not None and len(rec) != rec_nbytes:
+                    bad += 1
+                    if max_bad_records is not None and bad > max_bad_records:
+                        raise ValueError(
+                            "AsyncExecutor: %d malformed record(s) (got "
+                            "%d bytes, schema says %d) exceeds "
+                            "max_bad_records=%d — corrupt file or wrong "
+                            "DataFeedDesc?"
+                            % (bad, len(rec), rec_nbytes, max_bad_records))
+                    continue
                 batch.append(rec)
                 if len(batch) < bs:
                     continue
@@ -64,6 +92,11 @@ class AsyncExecutor:
                 steps += 1
                 if debug and steps % 100 == 0:
                     print("AsyncExecutor: %d steps" % steps)
+        if bad:
+            warnings.warn(
+                "AsyncExecutor: skipped %d malformed record(s) "
+                "(max_bad_records=%s)" % (bad, max_bad_records),
+                RuntimeWarning, stacklevel=2)
         if fetch_vals is None:
             raise RuntimeError(
                 "AsyncExecutor: no full batch assembled from %d file(s) — "

@@ -23,16 +23,31 @@ import os
 import shutil
 import threading
 import warnings
+import zipfile
+import zlib
 
 import numpy as np
 
 from .core import framework
 from .core.executor import global_scope
+from .reliability import faults
 
 __all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter",
            "resume_or_init", "AutoCheckpoint"]
 
 _MANIFEST = "checkpoint_manifest.json"
+
+
+class NoCheckpointError(IOError):
+    """The directory holds no complete ``checkpoint_<n>`` at all (cold
+    start) — distinct from "checkpoints exist but none loads"."""
+
+
+def _crc(arr):
+    """CRC32 of an array's raw bytes — recorded per array/piece in the
+    manifest at save, verified at load (the reference's recordio
+    chunk-CRC idea applied to checkpoints: disk bytes are not trusted)."""
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
 class CheckpointWriter:
@@ -123,6 +138,7 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
         else:
             rng_meta = {"impl": None}  # legacy raw uint32 key
             replicated["@RNG@"] = np.asarray(key)
+        rng_meta["crc"] = _crc(replicated["@RNG@"])
     for v in persist:
         if v.name not in scope:
             continue
@@ -131,7 +147,7 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
             arr = snap[1]
             manifest_vars[v.name] = {
                 "kind": "replicated", "shape": list(arr.shape),
-                "dtype": str(arr.dtype)}
+                "dtype": str(arr.dtype), "crc": _crc(arr)}
             if proc == 0:
                 replicated[v.name] = arr
         else:
@@ -140,6 +156,9 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
                 "kind": "sharded", "shape": list(gshape), "dtype": dtype,
                 "pieces": {
                     "p%d" % proc: [list(map(list, idx)) for idx, _ in pieces]
+                },
+                "crcs": {
+                    "p%d" % proc: [_crc(arr) for _, arr in pieces]
                 }}
             for k, (idx, arr) in enumerate(pieces):
                 sharded["%s@%d" % (v.name, k)] = arr
@@ -221,9 +240,10 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
                               sharded)
             if proc == 0:
                 # merge per-process piece indices written by others is a
-                # load-time concern; each process writes its own manifest
-                with open(os.path.join(vdir, _MANIFEST), "w") as f:
-                    json.dump(manifest, f, indent=1)
+                # load-time concern; each process writes its own manifest.
+                # Manifests land atomically: "manifest present" must mean
+                # "manifest complete" (the loaders' incomplete-dir check)
+                _json_atomic(os.path.join(vdir, _MANIFEST), manifest)
                 with open(os.path.join(checkpoint_dir, "latest.tmp"),
                           "w") as f:
                     f.write("checkpoint_%d" % version)
@@ -234,9 +254,8 @@ def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
                 _trim(checkpoint_dir, max_num_checkpoints,
                       grace_seconds=60.0 if nproc > 1 else 0.0)
             else:
-                with open(os.path.join(
-                        vdir, "manifest_p%d.json" % proc), "w") as f:
-                    json.dump(manifest, f, indent=1)
+                _json_atomic(os.path.join(vdir, "manifest_p%d.json" % proc),
+                             manifest)
         except BaseException as e:  # surfaced via .wait()
             writer.error = e
 
@@ -260,7 +279,33 @@ _last_writer = None
 def _savez_atomic(path, arrays):
     from .io import _atomic_savez  # shared tmp+rename npz writer
 
+    # fault site: an 'error' plan entry fails the write (surfaced via
+    # CheckpointWriter.wait), 'corrupt' damages the landed file so the
+    # CRC-verified load + fallback path can be drilled deterministically
+    mode = faults.trip("checkpoint.write")
     _atomic_savez(path, arrays)
+    if mode == "corrupt":
+        _flip_byte(path)
+
+
+def _flip_byte(path):
+    """Deterministically corrupt a landed file (mid-file byte flip)."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            return
+        f.seek(size // 2)
+        b = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def _json_atomic(path, obj):
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
 
 
 def _trim(checkpoint_dir, keep, grace_seconds=60.0):
@@ -291,22 +336,57 @@ def _trim(checkpoint_dir, keep, grace_seconds=60.0):
         shutil.rmtree(path, ignore_errors=True)
 
 
-def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
-                    main_program=None, scope=None, version=None):
-    """Restore every persistable from the newest (or given) checkpoint.
-    Sharded vars are reassembled from all processes' piece files; the next
-    ``exe.run`` re-shards them onto the mesh. Returns the manifest's
-    ``extra`` metadata dict."""
+def _candidate_versions(checkpoint_dir):
+    """Loadable version numbers, best first: the ``latest`` marker, then
+    the rest by WRITE RECENCY (step-derived versions are not monotonic
+    across a rollback resume, so the highest number may be a stale
+    abandoned-timeline dir). Entries that are not directories (leftover
+    ``*.tmp`` files from a crash mid-save) and version dirs without a
+    primary manifest (save killed before the manifest landed) are not
+    checkpoints and are skipped."""
+    by_mtime = []
+    for d in os.listdir(checkpoint_dir):
+        if not (d.startswith("checkpoint_") and d.split("_")[1].isdigit()):
+            continue
+        path = os.path.join(checkpoint_dir, d)
+        if not os.path.isdir(path):
+            continue
+        if not os.path.exists(os.path.join(path, _MANIFEST)):
+            continue  # incomplete: the save died before its manifest
+        try:
+            mt = os.path.getmtime(path)
+        except OSError:
+            continue
+        by_mtime.append((mt, int(d.split("_")[1])))
+    versions = [v for _, v in sorted(by_mtime, reverse=True)]
+    try:
+        with open(os.path.join(checkpoint_dir, "latest")) as f:
+            marked = int(f.read().strip().split("_")[1])
+        if marked in versions:
+            versions.remove(marked)
+            versions.insert(0, marked)
+    except (OSError, ValueError, IndexError):
+        pass
+    return versions
+
+
+def _verify_crc(vdir, label, arr, want):
+    if want is None:
+        return  # pre-CRC checkpoint: nothing recorded to verify against
+    got = _crc(arr)
+    if got != int(want):
+        raise IOError(
+            "checkpoint %s: CRC mismatch on %s (manifest %d != disk %d) "
+            "— bytes corrupted on disk" % (vdir, label, int(want), got))
+
+
+def _load_version(vdir, main_program):
+    """Read one ``checkpoint_<n>`` dir into a staged update list
+    ``[(scope_key, jax array), ...]`` plus the manifest's ``extra`` —
+    nothing touches the scope here, so a half-read corrupt version can be
+    abandoned for an older one without leaving torn state behind."""
     import jax.numpy as jnp
 
-    main_program = main_program or framework.default_main_program()
-    scope = scope or global_scope()
-    if version is None:
-        with open(os.path.join(checkpoint_dir, "latest")) as f:
-            vname = f.read().strip()
-    else:
-        vname = "checkpoint_%d" % version
-    vdir = os.path.join(checkpoint_dir, vname)
     with open(os.path.join(vdir, _MANIFEST)) as f:
         manifest = json.load(f)
 
@@ -322,12 +402,21 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
     # mix of runs, so they are skipped.
     nproc_saved = int(manifest.get("nproc", 1))
     run_expect = manifest.get("run_id")
-    piece_index = {}  # var name -> [(proc, [idx, ...])]
+    piece_index = {}  # var name -> [(proc, [idx, ...], [crc, ...]|None)]
     for pf in [os.path.join(vdir, _MANIFEST)] + [
             os.path.join(vdir, f) for f in sorted(os.listdir(vdir))
-            if f.startswith("manifest_p")]:
-        with open(pf) as f:
-            m = json.load(f)
+            if f.startswith("manifest_p") and f.endswith(".json")]:
+        try:
+            with open(pf) as f:
+                m = json.load(f)
+        except ValueError:
+            # a torn secondary manifest (crash mid-save): its pieces are
+            # simply absent; the coverage mask below decides whether the
+            # checkpoint is still whole
+            warnings.warn("checkpoint %s: unreadable secondary manifest "
+                          "%s (torn save?); skipping it"
+                          % (vdir, os.path.basename(pf)))
+            continue
         # a secondary manifest from a different save-run (abandoned
         # timeline reusing this step's dir): its shards are not this
         # checkpoint's — skip them; the coverage mask below then fails
@@ -337,13 +426,15 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
         if m.get("run_id") != run_expect:
             continue
         for name, meta in m["vars"].items():
+            crcs = meta.get("crcs", {})
             for pkey, idxs in meta.get("pieces", {}).items():
                 if int(pkey[1:]) >= nproc_saved:
                     continue
                 piece_index.setdefault(name, []).append(
-                    (int(pkey[1:]), idxs))
+                    (int(pkey[1:]), idxs, crcs.get(pkey)))
 
     persist = {v.name for v in main_program.list_vars() if v.persistable}
+    updates = []
     shard_cache = {}
     for name, meta in manifest["vars"].items():
         if name not in persist:
@@ -357,13 +448,15 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
                 raise IOError(
                     "checkpoint %s: replicated var %r missing from "
                     "replicated.npz (torn save?)" % (vdir, name))
-            scope.set(name, jnp.asarray(repl[name]))
+            arr = repl[name]
+            _verify_crc(vdir, name, arr, meta.get("crc"))
+            updates.append((name, jnp.asarray(arr)))
             continue
         full = np.zeros(tuple(meta["shape"]), dtype=meta["dtype"])
         # boolean coverage mask: piece indices may overlap across processes
         # (dp-replicated, mp-sharded layouts), so a counter can't validate
         covered = np.zeros(tuple(meta["shape"]), dtype=bool)
-        for pnum, idxs in piece_index.get(name, ()):
+        for pnum, idxs, crcs in piece_index.get(name, ()):
             if pnum not in shard_cache:
                 sf_path = os.path.join(vdir, "shards_p%d.npz" % pnum)
                 shard_cache[pnum] = (np.load(sf_path, allow_pickle=False)
@@ -380,8 +473,11 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
                     raise IOError(
                         "checkpoint %s: piece %s missing from "
                         "shards_p%d.npz" % (vdir, key, pnum))
+                piece = sf[key]
+                _verify_crc(vdir, "%s (shards_p%d)" % (key, pnum), piece,
+                            crcs[k] if crcs else None)
                 sl = tuple(slice(a, b) for a, b in idx)
-                full[sl] = sf[key]
+                full[sl] = piece
                 covered[sl] = True
         if not covered.all():
             raise IOError(
@@ -389,7 +485,7 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
                 "a process's shard file was never written (save on every "
                 "process, or the fs lost one)"
                 % (vdir, name, int(covered.sum()), covered.size))
-        scope.set(name, jnp.asarray(full))
+        updates.append((name, jnp.asarray(full)))
 
     # restore the threaded RNG stream so dropout randomness resumes
     # exactly where the interrupted run left off
@@ -398,6 +494,7 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
         import jax
 
         data = np.asarray(repl["@RNG@"])
+        _verify_crc(vdir, "@RNG@", data, rng_meta.get("crc"))
         if rng_meta.get("impl"):
             key = jax.random.wrap_key_data(jnp.asarray(data),
                                            impl=rng_meta["impl"])
@@ -405,8 +502,53 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
             key = jnp.asarray(data)
         from .core.op_registry import RNG_KEY
 
-        scope.set(RNG_KEY, key)
-    return manifest.get("extra", {})
+        updates.append((RNG_KEY, key))
+    return updates, manifest.get("extra", {})
+
+
+def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
+                    main_program=None, scope=None, version=None):
+    """Restore every persistable from the newest (or given) checkpoint.
+    Sharded vars are reassembled from all processes' piece files; the next
+    ``exe.run`` re-shards them onto the mesh. Returns the manifest's
+    ``extra`` metadata dict.
+
+    Integrity: every array is CRC-verified against the manifest. With
+    ``version=None`` a corrupt or incomplete newest version (including a
+    ``latest`` marker pointing at one) falls back to the next most
+    recently written intact ``checkpoint_<n>`` with a warning; an
+    explicit ``version`` raises instead. The scope is only written once a
+    whole version has read and verified clean."""
+    main_program = main_program or framework.default_main_program()
+    scope = scope or global_scope()
+    if version is not None:
+        updates, extra = _load_version(
+            os.path.join(checkpoint_dir, "checkpoint_%d" % version),
+            main_program)
+        for name, value in updates:
+            scope.set(name, value)
+        return extra
+    versions = _candidate_versions(checkpoint_dir)
+    if not versions:
+        raise NoCheckpointError(
+            "no complete checkpoint_<n> directory under %s"
+            % checkpoint_dir)
+    last_err = None
+    for v in versions:
+        try:
+            updates, extra = _load_version(
+                os.path.join(checkpoint_dir, "checkpoint_%d" % v),
+                main_program)
+        except (IOError, OSError, KeyError, ValueError, IndexError,
+                zipfile.BadZipFile) as e:
+            warnings.warn("checkpoint_%d is unusable (%s); falling back "
+                          "to the previous intact version" % (v, e))
+            last_err = e
+            continue
+        for name, value in updates:
+            scope.set(name, value)
+        return extra
+    raise last_err
 
 
 # ---------------------------------------------------------------------------
@@ -428,42 +570,16 @@ def resume_or_init(executor, startup_program, checkpoint_dir,
     executor.run(startup_program, scope=scope)
     if not os.path.isdir(checkpoint_dir):
         return None
-    # candidate order: the 'latest' marker first, then the rest by WRITE
-    # RECENCY (step-derived versions are not monotonic across a rollback
-    # resume, so the highest number may be a stale abandoned-timeline dir)
-    by_mtime = []
-    for d in os.listdir(checkpoint_dir):
-        if d.startswith("checkpoint_") and d.split("_")[1].isdigit():
-            try:
-                mt = os.path.getmtime(os.path.join(checkpoint_dir, d))
-            except OSError:
-                continue
-            by_mtime.append((mt, int(d.split("_")[1])))
-    versions = [v for _, v in sorted(by_mtime, reverse=True)]
+    # candidate order + corruption fallback live in load_checkpoint: the
+    # 'latest' marker first, then write recency; leftover *.tmp files and
+    # manifest-less dirs from a kill mid-save are not candidates at all,
+    # and a torn/corrupt newest version falls back (with a warning) to
+    # the previous intact one instead of crashing every restart
     try:
-        with open(os.path.join(checkpoint_dir, "latest")) as f:
-            marked = int(f.read().strip().split("_")[1])
-        if marked in versions:
-            versions.remove(marked)
-            versions.insert(0, marked)
-    except (OSError, ValueError, IndexError):
-        pass
-    if not versions:
-        return None
-    # a preemption can land mid-save (e.g. one process's shard file never
-    # written): fall back through older complete checkpoints instead of
-    # crashing every restart on the torn newest one
-    last_err = None
-    for v in versions:
-        try:
-            return load_checkpoint(executor, checkpoint_dir,
-                                   main_program=main_program, scope=scope,
-                                   version=v)
-        except (IOError, OSError, KeyError, ValueError) as e:
-            warnings.warn("checkpoint_%d is unusable (%s); trying the "
-                          "previous version" % (v, e))
-            last_err = e
-    raise last_err
+        return load_checkpoint(executor, checkpoint_dir,
+                               main_program=main_program, scope=scope)
+    except NoCheckpointError:
+        return None  # nothing saved yet: a cold start, not a failure
 
 
 class AutoCheckpoint:

@@ -1,7 +1,8 @@
 """Multi-process launcher (ref ``python/paddle/distributed/launch.py``):
 
     python -m paddle_tpu.distributed.launch --nproc_per_node=2 \\
-        [--started_port 6170] [--log_dir logs] train.py [args...]
+        [--started_port 6170] [--log_dir logs] \\
+        [--max_restarts N --restart_backoff S] train.py [args...]
 
 Spawns one worker per process slot with the PADDLE_TRAINER_* env protocol
 (``PADDLE_TRAINER_ID``, ``PADDLE_TRAINER_ENDPOINTS``,
@@ -10,9 +11,26 @@ consumes to form the jax.distributed world. Multi-node: pass
 ``--cluster_node_ips`` + ``--node_ip`` and run the launcher once per node,
 exactly like the reference.
 
-Failure semantics: first worker failure terminates the rest and the
-launcher exits with that worker's code (the reference's fate-sharing
-behavior, which elastic setups rely on for whole-job restart).
+Failure semantics (default): first worker failure terminates the rest and
+the launcher exits with that worker's code (the reference's fate-sharing
+behavior, which external whole-job restart setups rely on).
+
+Elastic mode (``--max_restarts N``): a worker crash restarts the WHOLE
+local group up to N times, with an exponential ``--restart_backoff``
+schedule (``paddle_tpu.reliability.RetryPolicy``) between attempts;
+state recovery is the workers' job via ``checkpoint.resume_or_init`` /
+``AutoCheckpoint`` (SURVEY §5.3 — the pserver ``checkpoint_notify`` +
+external-restart analog). Group restart — not restart-in-place of the
+one crashed process — because a jax.distributed world is all-or-nothing:
+a surviving peer would hang in its next collective waiting for the lost
+rank, and a respawned rank cannot rejoin an already-initialized world.
+Exhausting the budget falls back to fate-sharing. Worker log files are
+flushed/closed before a restart and reopened in append mode so one
+``workerlog.<id>`` carries the whole incarnation history; workers can
+read ``PADDLE_RESTART_COUNT`` to tell which incarnation they are.
+
+SIGTERM/SIGINT to the launcher are forwarded to the workers and the
+workers are reaped — Ctrl-C never orphans the subprocess tree.
 """
 
 import argparse
@@ -34,9 +52,58 @@ def _parse_args(argv):
     ap.add_argument("--node_ip", type=str, default="127.0.0.1")
     ap.add_argument("--started_port", type=int, default=6170)
     ap.add_argument("--log_dir", type=str, default=None)
+    ap.add_argument("--max_restarts", type=int, default=0,
+                    help="whole-group crash-restart budget (0 = "
+                         "fate-sharing, the default)")
+    ap.add_argument("--restart_backoff", type=float, default=1.0,
+                    help="base seconds before a group restart "
+                         "(doubles per attempt)")
     ap.add_argument("training_script", type=str)
     ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return ap.parse_args(argv)
+
+
+class _Worker:
+    """One process slot: its trainer id, live Popen, open log file, and
+    which restart incarnation it is on."""
+
+    __slots__ = ("tid", "proc", "log", "restarts")
+
+    def __init__(self, tid):
+        self.tid = tid
+        self.proc = None
+        self.log = None
+        self.restarts = 0
+
+    def close_log(self):
+        if self.log is not None:
+            try:
+                self.log.flush()
+                self.log.close()
+            except OSError:
+                pass
+            self.log = None
+
+
+def _reap(workers, sig=signal.SIGTERM, grace_s=10.0):
+    """Signal every live worker and wait it out; stragglers get SIGKILL."""
+    live = [w for w in workers if w.proc is not None
+            and w.proc.poll() is None]
+    for w in live:
+        try:
+            w.proc.send_signal(sig)
+        except OSError:
+            pass
+    deadline = time.time() + grace_s
+    for w in live:
+        try:
+            w.proc.wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            w.proc.kill()
+            try:
+                w.proc.wait(5.0)
+            except subprocess.TimeoutExpired:
+                pass
 
 
 def launch(argv=None):
@@ -56,53 +123,110 @@ def launch(argv=None):
     if args.log_dir:
         os.makedirs(args.log_dir, exist_ok=True)
 
-    procs = []
-    logs = []
-    for tid in local_ids:
+    # per-worker backoff schedule, shared with the serving layer's retry
+    # machinery: base * 2**k, deterministic (jitter would only desync the
+    # operator's expectations here)
+    if args.max_restarts > 0:
+        from ..reliability import RetryPolicy
+
+        backoffs = RetryPolicy(max_attempts=args.max_restarts + 1,
+                               base_delay_s=args.restart_backoff,
+                               max_delay_s=60.0, multiplier=2.0,
+                               jitter=0.0).delays()
+    else:
+        backoffs = []
+
+    def spawn(w, log_mode="w"):
         env = dict(os.environ)
         env.update({
-            "PADDLE_TRAINER_ID": str(tid),
+            "PADDLE_TRAINER_ID": str(w.tid),
             "PADDLE_TRAINERS_NUM": str(len(endpoints)),
             "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
-            "PADDLE_CURRENT_ENDPOINT": endpoints[tid],
+            "PADDLE_CURRENT_ENDPOINT": endpoints[w.tid],
+            "PADDLE_RESTART_COUNT": str(w.restarts),
         })
         cmd = [sys.executable, "-u", args.training_script,
                *args.training_script_args]
-        out = None
         if args.log_dir:
-            out = open(os.path.join(args.log_dir,
-                                    "workerlog.%d" % tid), "w")
-            logs.append(out)
-        procs.append(subprocess.Popen(cmd, env=env, stdout=out,
-                                      stderr=subprocess.STDOUT
-                                      if out else None))
+            w.log = open(os.path.join(args.log_dir,
+                                      "workerlog.%d" % w.tid), log_mode)
+        w.proc = subprocess.Popen(cmd, env=env, stdout=w.log,
+                                  stderr=subprocess.STDOUT
+                                  if w.log else None)
+
+    workers = [_Worker(tid) for tid in local_ids]
+    for w in workers:
+        spawn(w)
+
+    # forward termination to the workers: SIGTERM raises into the wait
+    # loop, which tears the tree down on the same path as Ctrl-C. Only
+    # installable from the main thread (in-process/test callers elsewhere
+    # keep their own handling).
+    def _on_term(signum, frame):
+        raise KeyboardInterrupt
+    prev_term = None
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:
+        pass
 
     rc = 0
+    restarts_used = 0
+    remaining = {w.tid: w for w in workers}
     try:
-        live = {p.pid: p for p in procs}
-        while live:
-            for pid, p in list(live.items()):
-                code = p.poll()
+        while remaining:
+            crashed = None
+            for tid, w in list(remaining.items()):
+                code = w.proc.poll()
                 if code is None:
                     continue
-                del live[pid]
-                if code != 0:
-                    # fate-sharing: one failure kills the job
-                    rc = code
-                    for q in live.values():
-                        q.send_signal(signal.SIGTERM)
-                    deadline = time.time() + 10
-                    for q in live.values():
-                        try:
-                            q.wait(max(0.1, deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            q.kill()
-                    live = {}
-                    break
+                if code == 0:
+                    w.close_log()
+                    del remaining[tid]
+                    continue
+                crashed = (tid, code)
+                break
+            if crashed is not None:
+                tid, code = crashed
+                if restarts_used < args.max_restarts:
+                    # elastic: tear the WHOLE group down (a partial world
+                    # would hang in its next collective), flush/close the
+                    # logs, back off, respawn everyone; each worker
+                    # recovers its own state through
+                    # resume_or_init/AutoCheckpoint
+                    delay = (backoffs[restarts_used]
+                             if restarts_used < len(backoffs)
+                             else backoffs[-1] if backoffs else 0.0)
+                    restarts_used += 1
+                    print("launch: worker %d exited %d; restarting the "
+                          "group (%d/%d) in %.1fs"
+                          % (tid, code, restarts_used, args.max_restarts,
+                             delay), file=sys.stderr)
+                    _reap(list(remaining.values()))
+                    for w in workers:
+                        w.close_log()
+                    time.sleep(delay)
+                    for w in workers:
+                        w.restarts = restarts_used
+                        spawn(w, log_mode="a")
+                    remaining = {w.tid: w for w in workers}
+                    continue
+                # fate-sharing: budget spent (or elastic mode off)
+                rc = code
+                del remaining[tid]
+                _reap(list(remaining.values()))
+                remaining = {}
+                break
             time.sleep(0.2)
+    except KeyboardInterrupt:
+        # Ctrl-C / SIGTERM: forward and reap — never orphan the workers
+        _reap(list(remaining.values()))
+        rc = 128 + signal.SIGTERM
     finally:
-        for f in logs:
-            f.close()
+        if prev_term is not None:
+            signal.signal(signal.SIGTERM, prev_term)
+        for w in workers:
+            w.close_log()
     return rc
 
 

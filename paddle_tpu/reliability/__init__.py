@@ -1,0 +1,36 @@
+"""``paddle_tpu.reliability`` — the fault-tolerance layer.
+
+The reference treats robustness as first-class plumbing — pserver RPC
+retry/deadline in brpc (``FLAGS_rpc_deadline``, ``grpc_client.cc``
+Proceed error paths) and coordinated recovery via ``checkpoint_notify``
+(``distribute_transpiler.py:1457``). This package is the TPU-native
+analog, shared by serving, the launcher, checkpoints, and the async
+ingest path:
+
+  * :mod:`~paddle_tpu.reliability.faults` — a seeded, deterministic
+    fault-injection harness (``PADDLE_TPU_FAULTS`` env var or an
+    explicit :class:`FaultPlan`). Recovery paths are *tested* against
+    injected failures, not hoped-for.
+  * :mod:`~paddle_tpu.reliability.policy` — composable
+    :class:`RetryPolicy` (exponential backoff + deterministic jitter,
+    injectable clock/sleep like ``DynamicBatcher``),
+    :class:`CircuitBreaker`, and :class:`Deadline` helpers.
+
+Consumers: ``serving.ServingEngine`` (replica circuit breakers,
+supervisor, cross-replica batch retry), ``distributed.launch``
+(elastic restart backoff schedule), ``checkpoint`` (write faults +
+CRC-verified load fallback), ``AsyncExecutor`` (bounded bad-record
+skip).
+"""
+
+from .faults import (FaultPlan, FaultSpec, InjectedFault,  # noqa: F401
+                     active_plan, corrupt_bytes, fault_scope, trip)
+from .policy import (CircuitBreaker, Deadline,  # noqa: F401
+                     DeadlineExpired, RetryError, RetryPolicy)
+
+__all__ = [
+    "FaultPlan", "FaultSpec", "InjectedFault", "active_plan",
+    "corrupt_bytes", "fault_scope", "trip",
+    "RetryPolicy", "RetryError", "CircuitBreaker", "Deadline",
+    "DeadlineExpired",
+]

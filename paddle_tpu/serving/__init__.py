@@ -22,10 +22,10 @@ from .admission import (AdmissionController, DeadlineExceededError,  # noqa: F40
 from .batcher import DynamicBatcher, Request  # noqa: F401
 from .buckets import (BucketError, bucket_for, pad_to_bucket,  # noqa: F401
                       pow2_ladder, unpad_fetch)
-from .engine import ServingEngine  # noqa: F401
+from .engine import EngineShutdownError, ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 
-__all__ = ["ServingEngine", "DynamicBatcher", "Request", "ServingMetrics",
-           "AdmissionController", "ServerOverloadedError",
-           "DeadlineExceededError", "BucketError", "pow2_ladder",
-           "bucket_for", "pad_to_bucket", "unpad_fetch"]
+__all__ = ["ServingEngine", "EngineShutdownError", "DynamicBatcher",
+           "Request", "ServingMetrics", "AdmissionController",
+           "ServerOverloadedError", "DeadlineExceededError", "BucketError",
+           "pow2_ladder", "bucket_for", "pad_to_bucket", "unpad_fetch"]

@@ -59,3 +59,11 @@ class AdmissionController:
     def release(self, n=1):
         with self._lock:
             self._in_flight = max(0, self._in_flight - n)
+
+    def shortfall(self, n=1):
+        """How many slots short the gate is of admitting ``n`` more
+        examples right now (0 = would be admitted)."""
+        with self._lock:
+            if self.max_queue_depth is None:
+                return 0
+            return max(0, self._in_flight + n - self.max_queue_depth)

@@ -23,9 +23,11 @@ __all__ = ["Request", "DynamicBatcher"]
 class Request:
     """One queued inference request: ``feed`` (dict name -> array with a
     leading batch dim), its example count ``n``, the caller's ``future``,
-    and the admission timestamps the deadline checks read."""
+    the admission timestamps the deadline checks read, and ``retries``
+    (how many times a failed batch has re-enqueued it — the engine's
+    cross-replica retry budget)."""
 
-    __slots__ = ("feed", "n", "future", "enqueue_t", "deadline")
+    __slots__ = ("feed", "n", "future", "enqueue_t", "deadline", "retries")
 
     def __init__(self, feed, n, future, enqueue_t, deadline=None):
         self.feed = feed
@@ -33,6 +35,7 @@ class Request:
         self.future = future
         self.enqueue_t = enqueue_t
         self.deadline = deadline
+        self.retries = 0
 
 
 class DynamicBatcher:
@@ -89,6 +92,36 @@ class DynamicBatcher:
             self._depth = 0
             self._cv.notify_all()
         return out
+
+    def shed_for(self, deadline, shortfall=1):
+        """Earliest-deadline-first shedding: pop and return the queued
+        request with the LATEST deadline, provided (a) it is strictly
+        later than ``deadline`` (``None`` = no deadline = infinitely
+        late, so deadline-less queue entries are shed first and a
+        deadline-less arrival can never displace anything) and (b) the
+        TOTAL sheddable depth — examples on strictly-later deadlines —
+        covers ``shortfall``, so a victim is never killed for an arrival
+        that could not be admitted anyway. Returns None otherwise — the
+        caller then falls back to plain rejection."""
+        inf = float("inf")
+        incoming = inf if deadline is None else deadline
+        with self._cv:
+            worst_i = None
+            worst_d = -inf
+            sheddable = 0
+            for i, r in enumerate(self._queue):
+                d = inf if r.deadline is None else r.deadline
+                if d <= incoming:
+                    continue
+                sheddable += r.n
+                if d >= worst_d:  # ties shed the youngest (least sunk wait)
+                    worst_i, worst_d = i, d
+            if worst_i is None or sheddable < shortfall:
+                return None
+            victim = self._queue[worst_i]
+            del self._queue[worst_i]
+            self._depth -= victim.n
+            return victim
 
     def _cut_locked(self):
         """Pop a batch: greedy fill up to max_batch_size examples."""

@@ -15,16 +15,29 @@ front batches requests. Here the same three-layer split is TPU-native:
     ``warmup()`` pre-compiles every rung before traffic lands.
 
 ``submit(feed) -> Future`` is the whole client API; ``shutdown(drain=True)``
-stops intake, serves what's queued, and joins the workers. A worker that
-crashes mid-batch fails only that batch's futures and keeps serving.
+stops intake, serves what's queued, and joins the workers.
+
+Self-healing (``paddle_tpu.reliability``): a failed batch gets ONE
+cross-replica retry before its futures fail (inference is idempotent —
+``donate_state=False`` since PR 1 means no state mutation); each replica
+carries a :class:`~paddle_tpu.reliability.CircuitBreaker` that, after K
+consecutive batch failures, evicts the predictor and rebuilds it from the
+parent via ``clone()``; a supervisor thread respawns worker threads that
+die outright; and under overload new arrivals with *earlier* deadlines
+displace the least-urgent queued request (EDF shedding) instead of being
+turned away FIFO-blind. Every event is counted in ``ServingMetrics``
+(shed / retried / evicted / respawned) — zero in a healthy run.
 """
 
 import threading
+import warnings
 from concurrent.futures import Future
 
 import numpy as np
 
 from ..inference import AnalysisConfig, Predictor
+from ..reliability import faults
+from ..reliability.policy import CircuitBreaker
 from .admission import (AdmissionController, DeadlineExceededError,
                         ServerOverloadedError)
 from .batcher import DynamicBatcher, Request
@@ -32,16 +45,24 @@ from .buckets import (bucket_for, edge_pad, pad_to_bucket, pow2_ladder,
                       unpad_fetch)
 from .metrics import ServingMetrics
 
-__all__ = ["ServingEngine"]
+__all__ = ["ServingEngine", "EngineShutdownError"]
+
+
+class EngineShutdownError(RuntimeError):
+    """The engine shut down before this admitted request could be served
+    (a worker was stuck or dead at shutdown); safe to retry elsewhere."""
 
 
 class _Worker:
-    """One replica: a predictor clone plus the shape signatures it has
+    """One replica: a predictor clone, the shape signatures it has
     dispatched (the engine-side view of its compile cache, valid for both
-    predictor types)."""
+    predictor types), and its circuit breaker (touched only by this
+    replica's worker thread)."""
 
-    def __init__(self, predictor):
+    def __init__(self, predictor, index, breaker):
         self.predictor = predictor
+        self.index = index
+        self.breaker = breaker
         self.seen_signatures = set()
         self.thread = None
 
@@ -50,13 +71,25 @@ class ServingEngine:
     def __init__(self, model, num_replicas=1, max_batch_size=8,
                  ladder=None, seq_ladder=None, max_wait_ms=5.0,
                  max_queue_depth=256, default_timeout_s=None, clock=None,
-                 latency_window=8192):
+                 latency_window=8192, max_replica_failures=3,
+                 cross_replica_retry=True, shed_on_overload=True,
+                 supervisor_interval_s=0.05):
         """``model``: a model directory / ``AnalysisConfig`` (loaded via
         ``Predictor``), or an already-constructed predictor exposing
         ``run``/``clone``/``feed_names`` (``Predictor`` or
-        ``StableHLOPredictor``)."""
+        ``StableHLOPredictor``).
+
+        Reliability knobs: ``max_replica_failures`` consecutive batch
+        failures evict a replica and rebuild it from the parent
+        (``None``/0 disables); ``cross_replica_retry`` re-enqueues a
+        failed batch's requests once before failing their futures;
+        ``shed_on_overload`` lets a full queue shed its least-urgent
+        (latest-deadline) entry for a more urgent arrival;
+        ``supervisor_interval_s`` is the dead-worker-thread sweep cadence
+        (``None`` disables the supervisor)."""
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
+        faults.maybe_install_from_env()
         if isinstance(model, (str, AnalysisConfig)):
             model = Predictor(model)
         if not callable(getattr(model, "clone", None)):
@@ -76,16 +109,30 @@ class ServingEngine:
         self.metrics_.bind_gauges(self._batcher.depth,
                                   lambda: self._admission.in_flight)
 
-        self._workers = [_Worker(model)]
-        for _ in range(num_replicas - 1):
-            self._workers.append(_Worker(model.clone()))
+        self._parent = model
+        self.max_replica_failures = max_replica_failures or 0
+        self.cross_replica_retry = bool(cross_replica_retry)
+        self.shed_on_overload = bool(shed_on_overload)
+
+        def breaker():
+            return CircuitBreaker(
+                failure_threshold=max(1, self.max_replica_failures or 1),
+                reset_timeout_s=0.0, clock=self._batcher.now)
+
+        self._workers = [_Worker(model, 0, breaker())]
+        for i in range(num_replicas - 1):
+            self._workers.append(_Worker(model.clone(), i + 1, breaker()))
         self._closed = False
         self._shutdown_done = False
-        for i, w in enumerate(self._workers):
-            w.thread = threading.Thread(
-                target=self._worker_loop, args=(w,),
-                name="paddle-tpu-serve-%d" % i, daemon=True)
-            w.thread.start()
+        self._stop_event = threading.Event()
+        for w in self._workers:
+            self._spawn_worker_thread(w)
+        self._supervisor = None
+        if supervisor_interval_s:
+            self._supervisor = threading.Thread(
+                target=self._supervisor_loop, args=(supervisor_interval_s,),
+                name="paddle-tpu-serve-supervisor", daemon=True)
+            self._supervisor.start()
 
     # -- client surface -----------------------------------------------------
     def submit(self, feed, timeout_s=None):
@@ -121,17 +168,37 @@ class ServingEngine:
                     # reject an over-long sequence at the door, not inside
                     # a batch where it would fail innocent co-riders
                     bucket_for(a.shape[1], self.seq_ladder)
-        try:
-            self._admission.acquire(n)
-        except ServerOverloadedError:
-            self.metrics_.observe_rejected()
-            raise
         timeout_s = (timeout_s if timeout_s is not None
                      else self.default_timeout_s)
         now = self._batcher.now()
-        req = Request(feed, n, Future(), now,
-                      deadline=(now + timeout_s
-                                if timeout_s is not None else None))
+        deadline = now + timeout_s if timeout_s is not None else None
+        while True:
+            try:
+                self._admission.acquire(n)
+                break
+            except ServerOverloadedError:
+                # EDF degradation: a full queue sheds its least-urgent
+                # (latest-deadline) entry for a strictly more urgent
+                # arrival; deadline-less work is the first to go and can
+                # displace nothing itself. The batcher checks feasibility
+                # atomically — enough strictly-later-deadline examples
+                # must be queued to cover the whole shortfall (shedding
+                # cannot reach capacity held by in-flight batches or
+                # more-urgent work), so no victim dies for an arrival
+                # that gets rejected anyway
+                short = self._admission.shortfall(n)
+                if short == 0:
+                    continue  # racing release freed the capacity: retry
+                victim = (self._batcher.shed_for(deadline, short)
+                          if self.shed_on_overload else None)
+                if victim is None:
+                    self.metrics_.observe_rejected()
+                    raise
+                self._fail(victim, ServerOverloadedError(
+                    "shed under overload: the slot went to a request "
+                    "with an earlier deadline"))
+                self.metrics_.observe_shed()
+        req = Request(feed, n, Future(), now, deadline=deadline)
         try:
             self._batcher.put(req)
         except RuntimeError:
@@ -189,11 +256,19 @@ class ServingEngine:
 
     def shutdown(self, drain=True, timeout_s=None):
         """Stop intake; with ``drain`` serve everything queued, otherwise
-        cancel it. Joins the worker threads. Idempotent."""
+        cancel it. Joins the worker threads (warning on any that outlive
+        ``timeout_s``); requests still queued after the join — a dead or
+        stuck replica raced a full batcher — fail with
+        :class:`EngineShutdownError` and return their admission slots
+        rather than leaking callers' futures. Idempotent."""
         self._closed = True
         if self._shutdown_done:
             return
         self._shutdown_done = True
+        self._stop_event.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout_s if timeout_s is not None
+                                  else 5.0)
         if not drain:
             for r in self._batcher.drain():
                 if r.future.cancel():
@@ -205,6 +280,20 @@ class ServingEngine:
         for w in self._workers:
             if w.thread is not None:
                 w.thread.join(timeout_s)
+                if w.thread.is_alive():
+                    warnings.warn(
+                        "ServingEngine.shutdown: replica %d (%s) still "
+                        "busy after %.1fs join timeout; its in-flight "
+                        "batch is abandoned to the daemon thread"
+                        % (w.index, w.thread.name, timeout_s or 0.0),
+                        RuntimeWarning, stacklevel=2)
+        # drain=True normally empties the queue through the workers; if
+        # one died/stuck with requests still queued, fail them loudly and
+        # give their admission capacity back
+        for r in self._batcher.drain():
+            self._fail(r, EngineShutdownError(
+                "ServingEngine shut down before this request was served"))
+            self.metrics_.observe_failed()
 
     def __enter__(self):
         return self
@@ -238,8 +327,35 @@ class ServingEngine:
                 feed[name] = np.full(shape, 0.5, dtype=dtype)
         return feed
 
+    def _spawn_worker_thread(self, worker):
+        worker.thread = threading.Thread(
+            target=self._worker_loop, args=(worker,),
+            name="paddle-tpu-serve-%d" % worker.index, daemon=True)
+        worker.thread.start()
+
+    def _supervisor_loop(self, interval_s):
+        """Self-healing sweep: a worker thread that died outright (an
+        escape below the batch-level containment) is respawned; its
+        replica state (predictor, breaker) carries over — the breaker
+        still evicts if the predictor itself is the problem."""
+        while not self._stop_event.wait(interval_s):
+            if self._closed:
+                return
+            for w in self._workers:
+                if self._closed:
+                    return
+                if w.thread is not None and not w.thread.is_alive():
+                    self._spawn_worker_thread(w)
+                    self.metrics_.observe_respawned()
+
     def _worker_loop(self, worker):
         while True:
+            # deterministic thread-death drills land here, BEFORE a batch
+            # is claimed: a killed worker never strands futures
+            try:
+                faults.trip("serving.worker")
+            except faults.InjectedFault:
+                return  # die quietly; the supervisor's sweep respawns us
             batch = self._batcher.get_batch()
             if batch is None:
                 return
@@ -250,6 +366,26 @@ class ServingEngine:
                 # reaching here (e.g. from metrics accounting) must not
                 # take the replica down with it
                 pass
+
+    def _rebuild_replica(self, worker):
+        """Evict a repeatedly-failing replica and rebuild it from the
+        parent predictor (weights shared; per-replica executor state —
+        the likely contaminant — is fresh)."""
+        try:
+            fresh = self._parent.clone()
+        except Exception as e:
+            # keep the old predictor; the breaker re-arms so another
+            # failure_threshold failures trigger the next rebuild attempt
+            # (not counted as an eviction — nothing was rebuilt)
+            warnings.warn(
+                "replica %d eviction: rebuild clone() failed (%r); "
+                "keeping the old predictor and re-arming the breaker"
+                % (worker.index, e), RuntimeWarning)
+        else:
+            worker.predictor = fresh
+            worker.seen_signatures = set()
+            self.metrics_.observe_evicted()
+        worker.breaker.reset()
 
     def _serve_batch(self, worker, batch):
         now = self._batcher.now()
@@ -268,6 +404,10 @@ class ServingEngine:
             live.append(r)
         if not live:
             return
+        # phase 1 — batch assembly. Failures here (disagreeing scalar
+        # feeds, bucket violations) are REQUEST-CONTENT errors: the
+        # replica is healthy and a retry can only repeat them, so they
+        # fail the batch without touching the breaker or the retry budget
         try:
             if len(live) == 1:
                 merged = live[0].feed
@@ -299,18 +439,31 @@ class ServingEngine:
             padded, n = pad_to_bucket(merged, self.ladder,
                                       seq_ladder=self.seq_ladder)
             rung = bucket_for(n, self.ladder)
-            sig = self._signature(padded)
-            hit = sig in worker.seen_signatures
-            worker.seen_signatures.add(sig)
-            outs = worker.predictor.run(padded)
-            outs = unpad_fetch(outs, n, padded_to=rung)
         except Exception as e:
-            # fail only this batch; the replica (and its clone-shared
-            # weights) keep serving
             for r in live:
                 self._fail(r, e)
             self.metrics_.observe_failed(len(live))
             return
+        # phase 2 — dispatch. Failures here are REPLICA faults: they
+        # count on the breaker (evict+rebuild on trip) and the batch's
+        # requests get their one cross-replica retry
+        try:
+            sig = self._signature(padded)
+            hit = sig in worker.seen_signatures
+            worker.seen_signatures.add(sig)
+            faults.trip("predictor.run")
+            outs = worker.predictor.run(padded)
+            outs = unpad_fetch(outs, n, padded_to=rung)
+        except Exception as e:
+            # fail only this batch; the replica (and its clone-shared
+            # weights) keeps serving — unless its breaker says the
+            # replica itself is the pattern, in which case evict+rebuild
+            if (self.max_replica_failures
+                    and worker.breaker.record_failure()):
+                self._rebuild_replica(worker)
+            self._dispose_failed(live, e)
+            return
+        worker.breaker.record_success()
         self.metrics_.observe_batch(actual=n, bucket=rung, cache_hit=hit)
         done_t = self._batcher.now()
         off = 0
@@ -325,6 +478,31 @@ class ServingEngine:
                 pass  # racing cancel; capacity still returns below
             self.metrics_.observe_completed(done_t - r.enqueue_t)
             self._admission.release(r.n)
+
+    def _dispose_failed(self, live, exc):
+        """A batch's ``predictor.run`` threw: requests that still have a
+        retry budget re-enqueue for another replica to pick up (inference
+        is idempotent — ``donate_state=False`` keeps clones read-only, so
+        a replay cannot double-apply anything); the rest fail with the
+        batch's exception. Retried requests KEEP their admission slot —
+        they never left the system."""
+        retry = []
+        for r in live:
+            if (self.cross_replica_retry and r.retries < 1
+                    and not self._closed):
+                r.retries += 1
+                retry.append(r)
+            else:
+                self._fail(r, exc)
+                self.metrics_.observe_failed()
+        for r in retry:
+            try:
+                self._batcher.put(r)
+            except RuntimeError:  # racing shutdown: no second chance left
+                self._fail(r, exc)
+                self.metrics_.observe_failed()
+                continue
+            self.metrics_.observe_retried()
 
     def _fail(self, req, exc):
         try:

@@ -23,6 +23,10 @@ class ServingMetrics:
         self._failed = 0
         self._rejected = 0
         self._expired = 0
+        self._shed = 0
+        self._retried = 0
+        self._evicted = 0
+        self._respawned = 0
         self._batches = 0
         self._batched_examples = 0
         self._bucket_slots = 0
@@ -54,6 +58,29 @@ class ServingMetrics:
         with self._lock:
             self._expired += n
 
+    def observe_shed(self, n=1):
+        """An admitted request displaced under overload by a new arrival
+        with an earlier deadline (EDF shedding)."""
+        with self._lock:
+            self._shed += n
+
+    def observe_retried(self, n=1):
+        """A request re-enqueued after its batch failed (cross-replica
+        retry); it will also count completed/failed when it resolves."""
+        with self._lock:
+            self._retried += n
+
+    def observe_evicted(self):
+        """A replica's circuit breaker tripped: predictor evicted and
+        rebuilt from the parent."""
+        with self._lock:
+            self._evicted += 1
+
+    def observe_respawned(self):
+        """The supervisor found a dead worker thread and restarted it."""
+        with self._lock:
+            self._respawned += 1
+
     def observe_batch(self, actual, bucket, cache_hit):
         with self._lock:
             self._batches += 1
@@ -76,6 +103,10 @@ class ServingMetrics:
                 "requests_failed": self._failed,
                 "requests_rejected": self._rejected,
                 "requests_expired": self._expired,
+                "requests_shed": self._shed,
+                "requests_retried": self._retried,
+                "replicas_evicted": self._evicted,
+                "workers_respawned": self._respawned,
                 "queue_depth": self._queue_depth_fn(),
                 "in_flight": self._in_flight_fn(),
                 "batches": batches,
@@ -104,7 +135,9 @@ class ServingMetrics:
             return "%d" % v
 
         for key in ("requests_completed", "requests_failed",
-                    "requests_rejected", "requests_expired", "queue_depth",
+                    "requests_rejected", "requests_expired",
+                    "requests_shed", "requests_retried",
+                    "replicas_evicted", "workers_respawned", "queue_depth",
                     "in_flight", "batches", "avg_batch_size",
                     "batch_occupancy", "compile_cache_hits",
                     "compile_cache_misses", "compile_cache_hit_rate"):

@@ -50,6 +50,12 @@ def test_serving_bench_record(monkeypatch):
     assert rec["config"]["clients"] == 2
     assert rec["config"]["replicas"] == 1
     assert rec["config"]["p99_budget_s"] > 0
+    # reliability counters ride along and are all ZERO in a healthy run —
+    # a nonzero means the number was earned under degradation
+    rel = rec["reliability"]
+    assert set(rel) == {"requests_shed", "requests_retried",
+                        "replicas_evicted", "workers_respawned"}
+    assert all(v == 0 for v in rel.values()), rel
 
 
 def test_seq_override_metric_suffix(monkeypatch):

@@ -1,0 +1,34 @@
+"""Lint gate: the suite runs ``tools/lint.sh`` (ruff when present,
+stdlib syntax gate otherwise) so style/correctness-floor violations fail
+CI the same way a broken test does."""
+
+import os
+import subprocess
+import sys
+
+
+def test_lint_gate_passes():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "tools", "lint.sh")
+    r = subprocess.run(["bash", script], cwd=repo, capture_output=True,
+                       text=True, timeout=300)
+    assert r.returncode == 0, "lint gate failed:\n%s\n%s" % (r.stdout,
+                                                             r.stderr)
+
+
+def test_lint_gate_catches_syntax_error(tmp_path):
+    """Whichever backend the gate picked, it must actually reject broken
+    code — guard against a silently-vacuous gate."""
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    for cmd in (["ruff", "check", str(bad)],
+                [sys.executable, "-m", "compileall", "-q", str(bad)]):
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=60)
+        except FileNotFoundError:
+            continue
+        if b"No module named" in r.stderr:
+            continue
+        assert r.returncode != 0
+        return
+    raise AssertionError("no lint backend available at all")

@@ -1,0 +1,754 @@
+"""paddle_tpu.reliability: deterministic fault injection, retry/backoff
+and breaker policies (fake clock, no sleeps), serving self-healing
+(eviction + rebuild, cross-replica retry, EDF shedding, supervisor
+respawn, shutdown hygiene), elastic launch with checkpoint resume, CRC
+checkpoint fallback, bounded bad-record skip, and a slow chaos soak."""
+
+import os
+import textwrap
+import threading
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu.distributed.launch import launch
+from paddle_tpu.reliability import (CircuitBreaker, Deadline, FaultPlan,
+                                    InjectedFault, RetryError, RetryPolicy,
+                                    corrupt_bytes, fault_scope)
+from paddle_tpu.serving import (EngineShutdownError, ServerOverloadedError,
+                                ServingEngine)
+
+
+# ---------------------------------------------------------------------------
+# fault plans — deterministic by construction
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_spec_parsing_and_determinism():
+    plan = FaultPlan.from_spec(
+        "predictor.run:error@1,3-4; checkpoint.write:corrupt@2")
+    hits = []
+    with fault_scope(plan):
+        for _ in range(5):
+            try:
+                plan.trip("predictor.run")
+                hits.append("ok")
+            except InjectedFault as e:
+                assert e.site == "predictor.run"
+                hits.append("err")
+        modes = [plan.trip("checkpoint.write") for _ in range(3)]
+    assert hits == ["err", "ok", "err", "err", "ok"]
+    assert modes == [None, "corrupt", None]
+    assert plan.counts() == {"predictor.run": 5, "checkpoint.write": 3}
+    with pytest.raises(ValueError, match="bad fault spec"):
+        FaultPlan.from_spec("nonsense")
+
+
+def test_fault_plan_env_and_module_trip(monkeypatch):
+    from paddle_tpu.reliability import faults
+
+    monkeypatch.setenv(faults.ENV_VAR, "recordio.read:hang(0.001)@1")
+    plan = FaultPlan.from_env()
+    assert plan.specs[0].kind == "hang"
+    assert plan.specs[0].hang_s == pytest.approx(0.001)
+    # no active plan: module-level trip is a no-op
+    assert faults.active_plan() is None
+    assert faults.trip("anything") is None
+    monkeypatch.delenv(faults.ENV_VAR)
+    assert FaultPlan.from_env() is None
+
+
+def test_fault_plan_chaos_seeded():
+    def decisions(plan, n=64):
+        out = []
+        for _ in range(n):
+            try:
+                plan.trip("s")
+                out.append(0)
+            except InjectedFault:
+                out.append(1)
+        return out
+
+    a = decisions(FaultPlan(seed=11, rate=0.25, chaos_sites=("s",)))
+    b = decisions(FaultPlan(seed=11, rate=0.25, chaos_sites=("s",)))
+    c = decisions(FaultPlan(seed=12, rate=0.25, chaos_sites=("s",)))
+    assert a == b
+    assert a != c
+    assert 0 < sum(a) < 64  # the rate actually fires, and not always
+
+
+def test_corrupt_bytes_changes_and_shrinks():
+    rec = b"\x01\x02\x03\x04"
+    bad = corrupt_bytes(rec)
+    assert len(bad) == len(rec) - 1 and bad != rec[:3]
+    assert corrupt_bytes(b"") == b""
+
+
+# ---------------------------------------------------------------------------
+# retry / breaker / deadline — injected time, zero real sleeping
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_retry_policy_schedule_deterministic():
+    p = RetryPolicy(max_attempts=4, base_delay_s=0.1, multiplier=2.0,
+                    jitter=0.0)
+    assert p.delays() == pytest.approx([0.1, 0.2, 0.4])
+    jittered = RetryPolicy(max_attempts=4, base_delay_s=0.1, jitter=0.5,
+                           seed=3)
+    assert jittered.delays() == jittered.delays()  # seeded => reproducible
+    for base, got in zip([0.1, 0.2, 0.4], jittered.delays()):
+        assert 0.5 * base <= got <= 1.5 * base
+    capped = RetryPolicy(max_attempts=5, base_delay_s=1.0, max_delay_s=2.5,
+                         jitter=0.0)
+    assert capped.delays() == pytest.approx([1.0, 2.0, 2.5, 2.5])
+
+
+def test_retry_policy_call_retries_then_succeeds():
+    slept = []
+    p = RetryPolicy(max_attempts=3, base_delay_s=0.5, jitter=0.0,
+                    sleep=slept.append)
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise IOError("transient")
+        return "done"
+
+    assert p.call(flaky) == "done"
+    assert slept == pytest.approx([0.5, 1.0])
+
+    def always():
+        raise IOError("down")
+
+    with pytest.raises(RetryError) as ei:
+        p.call(always)
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, IOError)
+    # non-retryable types propagate immediately
+    with pytest.raises(KeyError):
+        p.call(lambda: (_ for _ in ()).throw(KeyError("x")),
+               retry_on=(IOError,))
+
+
+def test_retry_policy_respects_deadline():
+    clock = FakeClock()
+    slept = []
+    p = RetryPolicy(max_attempts=5, base_delay_s=10.0, max_delay_s=100.0,
+                    jitter=0.0, sleep=slept.append)
+    d = Deadline(5.0, clock=clock)  # less than one 10s backoff
+    with pytest.raises(RetryError) as ei:
+        p.call(lambda: (_ for _ in ()).throw(IOError("x")), deadline=d)
+    assert slept == []  # never slept past the deadline
+    assert ei.value.attempts == 1  # only the attempts actually made
+
+
+def test_circuit_breaker_state_machine():
+    clock = FakeClock()
+    cb = CircuitBreaker(failure_threshold=3, reset_timeout_s=10.0,
+                        clock=clock)
+    assert cb.allow()
+    assert not cb.record_failure()
+    assert not cb.record_failure()
+    assert cb.record_failure()  # True exactly on the tripping transition
+    assert cb.state == CircuitBreaker.OPEN
+    assert not cb.allow()
+    clock.advance(10.1)
+    assert cb.allow()  # half-open probe
+    assert cb.state == CircuitBreaker.HALF_OPEN
+    assert cb.record_failure()  # probe failed -> re-open counts as a trip
+    clock.advance(10.1)
+    assert cb.allow()
+    cb.record_success()
+    assert cb.state == CircuitBreaker.CLOSED
+    assert cb.consecutive_failures == 0
+
+
+def test_deadline_helpers():
+    from paddle_tpu.reliability import DeadlineExpired
+
+    clock = FakeClock()
+    d = Deadline(2.0, clock=clock)
+    assert d.remaining() == pytest.approx(2.0)
+    clock.advance(1.0)
+    assert not d.expired()
+    assert d.require() == pytest.approx(1.0)
+    clock.advance(1.5)
+    assert d.expired()
+    with pytest.raises(DeadlineExpired, match="deadline"):
+        d.require()
+    assert Deadline(None, clock=clock).remaining() == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# serving self-healing — fake predictor, deterministic fault plans
+# ---------------------------------------------------------------------------
+
+class FakePredictor:
+    """Doubles its input; optional gate to hold the worker mid-run."""
+
+    feed_names = ["x"]
+
+    def __init__(self, gate=None):
+        self.gate = gate
+
+    def run(self, feed, return_numpy=True):
+        if self.gate is not None:
+            assert self.gate.wait(5.0), "test gate never opened"
+        return [np.asarray(feed["x"]) * 2.0]
+
+    def clone(self):
+        return FakePredictor(self.gate)
+
+
+def _drain_queue(eng, timeout=5.0):
+    t0 = time.time()
+    while eng._batcher.depth() > 0:
+        assert time.time() - t0 < timeout, "queue never drained"
+        time.sleep(0.001)
+
+
+def test_engine_evicts_and_rebuilds_failing_replica():
+    """ISSUE acceptance: predictor.run dies for 3 consecutive batches ->
+    the replica is evicted and rebuilt from the parent, no submitted
+    future is lost (each resolves or fails typed), throughput recovers."""
+    plan = FaultPlan.from_spec("predictor.run:error@1-3")
+    with fault_scope(plan):
+        eng = ServingEngine(FakePredictor(), num_replicas=1, ladder=(1, 2),
+                            max_wait_ms=0, max_queue_depth=64,
+                            max_replica_failures=3)
+        try:
+            futs = [eng.submit({"x": np.full((1, 2), float(i), "f4")})
+                    for i in range(6)]
+            outcomes = {"ok": 0, "fault": 0}
+            for i, f in enumerate(futs):
+                try:
+                    out, = f.result(10.0)
+                    np.testing.assert_array_equal(
+                        out, np.full((1, 2), 2.0 * i))
+                    outcomes["ok"] += 1
+                except InjectedFault:
+                    outcomes["fault"] += 1
+            assert sum(outcomes.values()) == 6  # nothing lost or hung
+            m = eng.metrics()
+            assert m["replicas_evicted"] == 1
+            # steady state after the rebuild: everything completes
+            after = [eng.submit({"x": np.ones((1, 2), "f4")})
+                     for _ in range(8)]
+            for f in after:
+                np.testing.assert_array_equal(f.result(10.0)[0],
+                                              np.full((1, 2), 2.0))
+            assert eng.metrics()["requests_failed"] == m["requests_failed"]
+        finally:
+            eng.shutdown()
+        assert eng._admission.in_flight == 0
+
+
+def test_engine_cross_replica_retry_masks_one_failure():
+    plan = FaultPlan.from_spec("predictor.run:error@1")
+    with fault_scope(plan):
+        eng = ServingEngine(FakePredictor(), num_replicas=2, ladder=(1, 2),
+                            max_wait_ms=0, max_queue_depth=16)
+        try:
+            f = eng.submit({"x": np.full((1, 2), 3.0, "f4")})
+            np.testing.assert_array_equal(f.result(10.0)[0],
+                                          np.full((1, 2), 6.0))
+            m = eng.metrics()
+            assert m["requests_retried"] == 1
+            assert m["requests_failed"] == 0
+        finally:
+            eng.shutdown()
+
+
+def test_engine_retry_disabled_fails_fast():
+    plan = FaultPlan.from_spec("predictor.run:error@1")
+    with fault_scope(plan):
+        eng = ServingEngine(FakePredictor(), num_replicas=1, ladder=(1,),
+                            max_wait_ms=0, cross_replica_retry=False)
+        try:
+            f = eng.submit({"x": np.ones((1, 2), "f4")})
+            with pytest.raises(InjectedFault):
+                f.result(10.0)
+            m = eng.metrics()
+            assert m["requests_failed"] == 1 and m["requests_retried"] == 0
+        finally:
+            eng.shutdown()
+
+
+def test_engine_edf_shedding_under_overload():
+    """A full queue sheds its latest-deadline entry for a more urgent
+    arrival; deadline-less arrivals still get plain rejection."""
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1, ladder=(1,),
+                        max_wait_ms=0, max_queue_depth=3)
+    try:
+        blocker = eng.submit({"x": np.ones((1, 2), "f4")})
+        _drain_queue(eng)  # worker holds `blocker` at the gate
+        lazy = [eng.submit({"x": np.ones((1, 2), "f4")}, timeout_s=100.0)
+                for _ in range(2)]  # queue now at the depth limit
+        urgent = eng.submit({"x": np.full((1, 2), 7.0, "f4")},
+                            timeout_s=0.5)
+        m = eng.metrics()
+        assert m["requests_shed"] == 1 and m["requests_rejected"] == 0
+        shed = [f for f in lazy if f.done()]
+        assert len(shed) == 1
+        with pytest.raises(ServerOverloadedError, match="shed"):
+            shed[0].result(0.0)
+        # a deadline-less arrival can displace nothing: plain rejection
+        with pytest.raises(ServerOverloadedError):
+            eng.submit({"x": np.ones((1, 2), "f4")})
+        assert eng.metrics()["requests_rejected"] == 1
+        gate.set()
+        assert urgent.result(10.0)
+    finally:
+        gate.set()
+        eng.shutdown()
+    assert eng._admission.in_flight == 0
+
+
+def test_engine_shed_requires_feasibility():
+    """When the shortage sits in in-flight batches rather than the
+    queue, shedding cannot admit the arrival — reject it WITHOUT
+    killing queued work for nothing."""
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1,
+                        ladder=(1, 2, 4), max_wait_ms=0,
+                        max_queue_depth=4)
+    try:
+        blocker = eng.submit({"x": np.ones((2, 2), "f4")})
+        _drain_queue(eng)  # 2 examples in flight at the gate
+        queued = eng.submit({"x": np.ones((1, 2), "f4")}, timeout_s=100.0)
+        # n=4: shortfall is 3 but only 1 example is queued — infeasible,
+        # so the queued request must survive
+        with pytest.raises(ServerOverloadedError):
+            eng.submit({"x": np.ones((4, 2), "f4")}, timeout_s=0.1)
+        m = eng.metrics()
+        assert m["requests_rejected"] == 1 and m["requests_shed"] == 0
+        assert not queued.done()
+        gate.set()
+        assert blocker.result(10.0) and queued.result(10.0)
+    finally:
+        gate.set()
+        eng.shutdown()
+    assert eng._admission.in_flight == 0
+
+
+def test_engine_shed_only_counts_later_deadline_depth():
+    """Feasibility counts only strictly-LATER-deadline examples: a
+    deadline-less victim must not die when the rest of the shortfall
+    sits on deadlines more urgent than the arrival's."""
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1,
+                        ladder=(1, 2, 4), max_wait_ms=0,
+                        max_queue_depth=4)
+    try:
+        blocker = eng.submit({"x": np.ones((2, 2), "f4")})
+        _drain_queue(eng)  # 2 examples in flight at the gate
+        lazy = eng.submit({"x": np.ones((1, 2), "f4")})  # no deadline
+        urgent_q = eng.submit({"x": np.ones((1, 2), "f4")},
+                              timeout_s=0.2)
+        # arrival n=2, deadline 1.0: shortfall 2, but only the
+        # deadline-less request (1 example) is strictly later — shedding
+        # it could not admit the arrival, so it must survive
+        with pytest.raises(ServerOverloadedError):
+            eng.submit({"x": np.ones((2, 2), "f4")}, timeout_s=1.0)
+        m = eng.metrics()
+        assert m["requests_shed"] == 0 and m["requests_rejected"] == 1
+        assert not lazy.done() and not urgent_q.done()
+        gate.set()
+        assert blocker.result(10.0) and lazy.result(10.0)
+    finally:
+        gate.set()
+        eng.shutdown()
+    assert eng._admission.in_flight == 0
+
+
+def test_engine_supervisor_respawns_dead_workers():
+    plan = FaultPlan.from_spec("serving.worker:error@1-2")
+    with fault_scope(plan):
+        eng = ServingEngine(FakePredictor(), num_replicas=2, ladder=(1, 2),
+                            max_wait_ms=0, max_queue_depth=16,
+                            supervisor_interval_s=0.01)
+        try:
+            # both worker threads die on their first loop pass; the
+            # supervisor sweep must bring the pool back
+            t0 = time.time()
+            while eng.metrics()["workers_respawned"] < 2:
+                assert time.time() - t0 < 10.0, "supervisor never respawned"
+                time.sleep(0.005)
+            f = eng.submit({"x": np.full((1, 2), 4.0, "f4")})
+            np.testing.assert_array_equal(f.result(10.0)[0],
+                                          np.full((1, 2), 8.0))
+        finally:
+            eng.shutdown()
+
+
+def test_engine_shutdown_warns_on_stuck_replica_and_releases_queue():
+    gate = threading.Event()
+    eng = ServingEngine(FakePredictor(gate), num_replicas=1, ladder=(1,),
+                        max_wait_ms=0, max_queue_depth=8)
+    running = eng.submit({"x": np.ones((1, 2), "f4")})
+    _drain_queue(eng)  # worker holds `running` at the gate
+    queued = eng.submit({"x": np.ones((1, 2), "f4")})
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng.shutdown(drain=True, timeout_s=0.2)
+    assert any("replica 0" in str(w.message) and "still busy"
+               in str(w.message) for w in caught)
+    # the queued request raced a stuck replica: failed typed, slot freed
+    assert isinstance(queued.exception(5.0), EngineShutdownError)
+    assert eng._admission.in_flight == 1  # only the in-flight request
+    gate.set()
+    assert running.result(10.0)
+    for w in eng._workers:
+        w.thread.join(10.0)
+    assert eng._admission.in_flight == 0
+
+
+# ---------------------------------------------------------------------------
+# elastic launch (ISSUE acceptance: crash once -> resume -> exit 0)
+# ---------------------------------------------------------------------------
+
+def test_launch_elastic_restart_resumes_from_checkpoint(tmp_path,
+                                                        monkeypatch):
+    """--max_restarts 2 on a worker scripted to crash once (right after
+    its step-3 checkpoint lands): the restarted incarnation resumes from
+    AutoCheckpoint at step 3 and the job exits 0."""
+    ckpt = tmp_path / "ckpt"
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        import numpy as np
+        import paddle_tpu as fluid
+
+        ckpt = os.environ["TEST_CKPT_DIR"]
+        main, startup = fluid.Program(), fluid.Program()
+        main.random_seed = startup.random_seed = 3
+        scope = fluid.Scope()
+        with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+            x = fluid.layers.data("x", shape=[4])
+            y = fluid.layers.data("y", shape=[1])
+            loss = fluid.layers.mean(fluid.layers.square_error_cost(
+                fluid.layers.fc(x, size=1), y))
+            fluid.optimizer.SGD(0.05).minimize(loss)
+            exe = fluid.Executor(fluid.CPUPlace())
+            extra = fluid.checkpoint.resume_or_init(exe, startup, ckpt,
+                                                    main_program=main)
+            start = (extra or {}).get("step", 0)
+            first_boot = os.environ["PADDLE_RESTART_COUNT"] == "0"
+            assert start == (0 if first_boot else 3), (start, first_boot)
+            ac = fluid.checkpoint.AutoCheckpoint(exe, ckpt,
+                                                 main_program=main,
+                                                 every_steps=1)
+            rng = np.random.RandomState(0)
+            xs = rng.randn(8, 4).astype("f4")
+            ys = rng.randn(8, 1).astype("f4")
+            for s in range(start, 6):
+                exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+                ac.step({"step": s + 1})
+                if s + 1 == 3 and first_boot:
+                    ac.close()
+                    sys.exit(23)   # crash AFTER the step-3 ckpt landed
+            ac.close()
+            with open(os.path.join(ckpt, "done.txt"), "w") as f:
+                f.write("resumed_from=%d" % start)
+    """))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    monkeypatch.setenv("TEST_CKPT_DIR", str(ckpt))
+    monkeypatch.setenv("PYTHONPATH", repo + os.pathsep
+                       + os.environ.get("PYTHONPATH", ""))
+    rc = launch(["--nproc_per_node=1", "--max_restarts=2",
+                 "--restart_backoff=0.1",
+                 "--log_dir", str(tmp_path / "logs"), str(script)])
+    assert rc == 0
+    assert (ckpt / "done.txt").read_text() == "resumed_from=3"
+
+
+def test_launch_elastic_restarts_whole_group(tmp_path):
+    """One crashed worker restarts the WHOLE group (a partial
+    jax.distributed world would hang in its next collective): worker 1
+    crashes on its first incarnation, and worker 0 — though healthy —
+    is reaped and respawned alongside it."""
+    script = tmp_path / "w.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys, time
+        tid = os.environ["PADDLE_TRAINER_ID"]
+        boots = os.environ["PADDLE_RESTART_COUNT"]
+        with open("boots_%s_%s" % (tid, boots), "w") as f:
+            f.write("up")
+        if tid == "1" and boots == "0":
+            sys.exit(5)
+        time.sleep(0.3)
+    """))
+    import subprocess
+    import sys
+
+    # run via subprocess so the launcher's cwd (where boot files land)
+    # is the tmp dir
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=2", "--max_restarts=1",
+         "--restart_backoff=0.1", str(script)],
+        cwd=str(tmp_path), env=env, timeout=120)
+    assert r.returncode == 0
+    booted = sorted(f for f in os.listdir(tmp_path)
+                    if f.startswith("boots_"))
+    # both workers ran incarnation 0 AND incarnation 1
+    assert booted == ["boots_0_0", "boots_0_1",
+                      "boots_1_0", "boots_1_1"], booted
+
+
+def test_launch_sigterm_forwarded_and_reaped(tmp_path):
+    """SIGTERM to the launcher reaches the workers and reaps them —
+    no orphans (the Ctrl-C satellite, drilled via a real process tree)."""
+    import signal
+    import subprocess
+    import sys
+
+    script = tmp_path / "sleeper.py"
+    script.write_text(textwrap.dedent("""
+        import os, time
+        with open("pid_%s" % os.environ["PADDLE_TRAINER_ID"], "w") as f:
+            f.write(str(os.getpid()))
+        time.sleep(60)
+    """))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node=1", str(script)],
+        cwd=str(tmp_path), env=env)
+    pidfile = tmp_path / "pid_0"
+    t0 = time.time()
+    while not pidfile.exists():
+        assert time.time() - t0 < 30, "worker never started"
+        time.sleep(0.05)
+    wpid = int(pidfile.read_text())
+    p.send_signal(signal.SIGTERM)
+    rc = p.wait(20)
+    assert rc == 128 + signal.SIGTERM
+    t0 = time.time()
+    while time.time() - t0 < 5:
+        try:
+            os.kill(wpid, 0)
+        except ProcessLookupError:
+            break
+        time.sleep(0.05)
+    else:
+        os.kill(wpid, 9)
+        pytest.fail("worker survived the launcher's SIGTERM")
+
+
+# ---------------------------------------------------------------------------
+# checkpoint integrity: CRC verify + fallback, kill-during-save hygiene
+# ---------------------------------------------------------------------------
+
+def _tiny_training(ckpt, n_saves, start_meta=0):
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        for i in range(n_saves):
+            fluid.io.save_checkpoint(
+                exe, str(ckpt), main_program=main, max_num_checkpoints=8,
+                async_write=False, extra_meta={"i": start_meta + i})
+    return main, startup, scope, exe
+
+
+def test_checkpoint_crc_mismatch_falls_back_with_warning(tmp_path):
+    """ISSUE acceptance: a corrupted `latest` checkpoint loads the
+    previous intact version with a warning instead of raising."""
+    import json
+
+    ckpt = tmp_path / "c"
+    main, startup, scope, exe = _tiny_training(ckpt, 2)
+    with fluid.scope_guard(scope):
+        vdir = ckpt / "checkpoint_1"
+        man = json.loads((vdir / "checkpoint_manifest.json").read_text())
+        assert all("crc" in m for m in man["vars"].values()
+                   if m["kind"] == "replicated")
+        # rewrite one array's values, keeping the manifest's CRCs: only
+        # the CRC verify can catch this (the npz itself is well-formed)
+        repl = dict(np.load(vdir / "replicated.npz"))
+        name = next(k for k in repl if k != "@RNG@")
+        repl[name] = repl[name] + 1.0
+        np.savez(str(vdir / "replicated"), **repl)
+        with pytest.warns(UserWarning, match="CRC mismatch"):
+            extra = fluid.io.load_checkpoint(exe, str(ckpt),
+                                             main_program=main)
+        assert extra == {"i": 0}
+        # explicit version pins raise instead of silently falling back
+        with pytest.raises(IOError, match="CRC mismatch"):
+            fluid.io.load_checkpoint(exe, str(ckpt), main_program=main,
+                                     version=1)
+
+
+def test_checkpoint_fault_injected_corrupt_write_detected(tmp_path):
+    ckpt = tmp_path / "c"
+    main, startup, scope, exe = _tiny_training(ckpt, 1)
+    with fluid.scope_guard(scope):
+        with fault_scope(FaultPlan.from_spec("checkpoint.write:corrupt@1")):
+            fluid.io.save_checkpoint(exe, str(ckpt), main_program=main,
+                                     async_write=False,
+                                     extra_meta={"i": 99})
+        with pytest.warns(UserWarning, match="unusable"):
+            extra = fluid.io.load_checkpoint(exe, str(ckpt),
+                                             main_program=main)
+        assert extra == {"i": 0}
+
+
+def test_resume_skips_tmp_litter_and_incomplete_version(tmp_path):
+    """Kill-during-save drill: `latest` points at a version dir that has
+    shard litter but no manifest (the save died first), with *.tmp files
+    lying around — resume must pick the previous intact checkpoint."""
+    ckpt = tmp_path / "c"
+    main, startup, scope, exe = _tiny_training(ckpt, 2)
+    with fluid.scope_guard(scope):
+        torn = ckpt / "checkpoint_9"
+        torn.mkdir()
+        (torn / "replicated.npz.tmp.4242").write_bytes(b"half a write")
+        (ckpt / "checkpoint_5.tmp").write_bytes(b"not a dir")
+        (ckpt / "latest").write_text("checkpoint_9")
+        extra = fluid.checkpoint.resume_or_init(exe, startup, str(ckpt),
+                                                main_program=main)
+        assert extra == {"i": 1}
+    # a dir full of *.tmp litter only (no intact version at all)
+    lone = tmp_path / "lone"
+    lone.mkdir()
+    (lone / "checkpoint_0").mkdir()
+    (lone / "checkpoint_0" / "x.tmp").write_bytes(b"junk")
+    main2, startup2 = fluid.Program(), fluid.Program()
+    scope2 = fluid.Scope()
+    with fluid.program_guard(main2, startup2), fluid.scope_guard(scope2):
+        fluid.unique_name.switch()
+        x = fluid.layers.data("x", shape=[4])
+        fluid.layers.mean(fluid.layers.fc(x, size=2))
+        exe2 = fluid.Executor(fluid.CPUPlace())
+        assert fluid.checkpoint.resume_or_init(
+            exe2, startup2, str(lone), main_program=main2) is None
+
+
+# ---------------------------------------------------------------------------
+# async ingest: bounded bad-record skip
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not __import__("paddle_tpu.native", fromlist=["x"])
+                    .native_available(),
+                    reason="native toolchain unavailable")
+def test_async_executor_bounded_bad_record_skip(tmp_path):
+    from paddle_tpu import native
+
+    desc = fluid.DataFeedDesc([("x", (4,), "float32"),
+                               ("y", (1,), "int64")], batch_size=8)
+    rng = np.random.RandomState(0)
+    path = str(tmp_path / "p.recordio")
+    with native.RecordIOWriter(path) as wr:
+        for i in range(32):
+            wr.write(desc.serialize({"x": rng.randn(4).astype("f4"),
+                                     "y": [i % 3]}))
+            if i % 10 == 0:
+                wr.write(b"torn!")  # 4 malformed records
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(x, size=3), y))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        async_exe = fluid.AsyncExecutor()
+        # default stays fail-fast
+        with pytest.raises(ValueError, match="max_bad_records=0"):
+            async_exe.run(main, desc, [path], fetch=[loss], scope=scope)
+        # bounded skip: counted, warned, training proceeds
+        with pytest.warns(RuntimeWarning, match="skipped 4 malformed"):
+            out, = async_exe.run(main, desc, [path], fetch=[loss],
+                                 scope=scope, max_bad_records=4)
+        assert np.isfinite(float(out))
+        # bound one short of the damage: aborts
+        with pytest.raises(ValueError, match="max_bad_records=3"):
+            async_exe.run(main, desc, [path], fetch=[loss], scope=scope,
+                          max_bad_records=3)
+
+
+# ---------------------------------------------------------------------------
+# chaos soak — random seeded faults, no lost futures, no deadlock
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_serving_chaos_soak_no_lost_futures():
+    plan = FaultPlan(seed=1337, rate=0.08,
+                     chaos_sites=("predictor.run", "serving.worker"))
+    with fault_scope(plan):
+        eng = ServingEngine(FakePredictor(), num_replicas=3,
+                            ladder=(1, 2, 4), max_wait_ms=1,
+                            max_queue_depth=64, max_replica_failures=2,
+                            supervisor_interval_s=0.02)
+        stop = time.time() + 2.0
+        lock = threading.Lock()
+        tallies = {"ok": 0, "fault": 0, "overload": 0}
+        problems = []
+
+        def client(seed):
+            rng = np.random.RandomState(seed)
+            while time.time() < stop:
+                n = int(rng.randint(1, 4))
+                x = rng.randn(n, 2).astype("f4")
+                try:
+                    fut = eng.submit({"x": x}, timeout_s=10.0)
+                except ServerOverloadedError:
+                    with lock:
+                        tallies["overload"] += 1
+                    time.sleep(0.002)
+                    continue
+                try:
+                    out, = fut.result(10.0)
+                    if out.shape[0] != n:
+                        raise AssertionError("shape mismatch")
+                    with lock:
+                        tallies["ok"] += 1
+                except (InjectedFault, ServerOverloadedError):
+                    with lock:
+                        tallies["fault"] += 1  # typed failure: acceptable
+                except Exception as e:  # noqa: BLE001 — soak collects all
+                    problems.append(e)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+            assert not t.is_alive(), "client deadlocked"
+        try:
+            assert not problems, problems[:3]
+            m = eng.metrics()
+            assert tallies["ok"] > 50  # the engine kept serving throughout
+            assert m["queue_depth"] == 0
+        finally:
+            eng.shutdown(drain=True, timeout_s=10.0)
+        assert eng._admission.in_flight == 0
