@@ -177,8 +177,17 @@ def build_step_fn(program, fetch_names, persist_names, pp_cfg=None,
     invalidates nor copies the shared weights."""
     from .op_registry import env_flag
     from .opt_fusion import plan_opt_fusion, run_fused_group
+    from .epilogue_fusion import fuse_ops, fusion_enabled
 
     ops = list(program.global_block().ops)
+    if fusion_enabled() and pp_cfg is None:
+        # conv->BN(+add)->relu epilogue fusion (the build_strategy.cc
+        # analog), applied to the traced op list — the user's program is
+        # not mutated, and the autodiff replay lists are rewritten too so
+        # the backward recomputation sees the fused ops. Skipped under
+        # pipeline parallelism: stage boundaries are named vars that an
+        # absorbed intermediate could erase.
+        ops, _ = fuse_ops(ops, protected=set(fetch_names))
     persist_set = set(persist_names)
     if infer_only:
         produced = set()

@@ -274,12 +274,22 @@ class Operator:
         self.outputs = {}
         self.attrs = dict(attrs) if attrs else {}
         self.callsite = None  # (file, line, function) set by Block.append_op
+        # a None value (or entry) means "slot absent" — several layer
+        # builders pass optional slots through unconditionally, and every
+        # consumer (impls via op.input(), dataflow via input_arg_names)
+        # treats a missing slot and None identically
         if inputs:
             for slot, vs in inputs.items():
-                self.inputs[slot] = list(vs) if isinstance(vs, (list, tuple)) else [vs]
+                vs = list(vs) if isinstance(vs, (list, tuple)) else [vs]
+                vs = [v for v in vs if v is not None]
+                if vs:
+                    self.inputs[slot] = vs
         if outputs:
             for slot, vs in outputs.items():
-                self.outputs[slot] = list(vs) if isinstance(vs, (list, tuple)) else [vs]
+                vs = list(vs) if isinstance(vs, (list, tuple)) else [vs]
+                vs = [v for v in vs if v is not None]
+                if vs:
+                    self.outputs[slot] = vs
 
     def input(self, slot):
         vs = self.inputs.get(slot, [])

@@ -154,11 +154,13 @@ def _crf_decoding(env, op):
     live = jnp.arange(t_max)[None] < length[:, None]
     path = jnp.where(live, path, 0)
     lbl_var = op.input("Label")
+    # int32, not int64: jax truncates int64 (with a loud UserWarning)
+    # unless x64 mode is on — request the effective dtype explicitly
     if lbl_var is not None:
         lbl = env[lbl_var.name].astype(path.dtype)
-        out = ((path == lbl) & live).astype(jnp.int64)
+        out = ((path == lbl) & live).astype(jnp.int32)
     else:
-        out = path.astype(jnp.int64)
+        out = path.astype(jnp.int32)
     put(env, op.output("ViterbiPath"), out)
 
 

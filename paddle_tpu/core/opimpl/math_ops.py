@@ -389,16 +389,19 @@ def _cumsum(env, op):
 
 # ---------------- search / sort ----------------
 
+# index outputs are int32, not int64: without x64 mode jax truncates an
+# explicit int64 request to int32 anyway, emitting a UserWarning per trace
+# (the resnet50 bench tail in BENCH_r05.json) — request the real dtype
 @register("argmax")
 def _argmax(env, op):
     put(env, op.output("Out"),
-        jnp.argmax(get(env, op.input("X")), axis=op.attr("axis", -1)).astype(jnp.int64))
+        jnp.argmax(get(env, op.input("X")), axis=op.attr("axis", -1)).astype(jnp.int32))
 
 
 @register("argmin")
 def _argmin(env, op):
     put(env, op.output("Out"),
-        jnp.argmin(get(env, op.input("X")), axis=op.attr("axis", -1)).astype(jnp.int64))
+        jnp.argmin(get(env, op.input("X")), axis=op.attr("axis", -1)).astype(jnp.int32))
 
 
 @register("argsort")
@@ -406,7 +409,7 @@ def _argsort(env, op):
     x = get(env, op.input("X"))
     axis = op.attr("axis", -1)
     idx = jnp.argsort(x, axis=axis)
-    put(env, op.output("Indices"), idx.astype(jnp.int64))
+    put(env, op.output("Indices"), idx.astype(jnp.int32))
     put(env, op.output("Out"), jnp.sort(x, axis=axis))
 
 
@@ -416,7 +419,7 @@ def _top_k(env, op):
     k = op.attr("k", 1)
     vals, idx = jax.lax.top_k(x, k)
     put(env, op.output("Out"), vals)
-    put(env, op.output("Indices"), idx.astype(jnp.int64))
+    put(env, op.output("Indices"), idx.astype(jnp.int32))
 
 
 @register("isfinite")

@@ -262,6 +262,65 @@ def _depthwise_conv2d_transpose(env, op):
         conv_transpose_nchw(x, w, strides, pads, dil, groups=x.shape[1]))
 
 
+@register("fused_conv2d")
+def _fused_conv2d(env, op):
+    """conv2d + batch_norm (+residual add)(+relu) as ONE op, produced by
+    the epilogue-fusion rewrite (``core/epilogue_fusion.py``). On a single
+    TPU with a supported geometry it lowers to the Pallas epilogue kernels
+    (``ops/fused_conv.py``: the conv-out stats pass and the separate
+    residual/relu passes leave the HBM bytes model); everywhere else it
+    replays the absorbed original ops verbatim, so the rewrite is
+    numerics-neutral by construction on the fallback path."""
+    from ...ops import fused_conv
+    from ..op_registry import mxu_cast, run_op
+    from ..framework import Operator
+
+    is_test = op.attr("is_test", False)
+    use_global = op.attr("use_global_stats", False)
+    x = get(env, op.input("Input"))  # NCHW
+    w = get(env, op.input("Filter"))  # OIHW
+    x, w = mxu_cast(x, w)
+    strides = _pair(op.attr("strides", [1, 1]))
+    pads = _pair(op.attr("paddings", [0, 0]))
+    dil = _pair(op.attr("dilations", [1, 1]))
+    groups = op.attr("groups", 1) or 1
+    res_var = op.input("Residual")
+    residual = get(env, res_var) if res_var is not None else None
+
+    if not fused_conv.use_pallas(x.shape, w.shape, strides, pads, dil,
+                                 groups, x.dtype.itemsize,
+                                 residual is not None):
+        for sub in op.attr("orig_ops") or ():
+            if is_test and not sub.attr("is_test", False) \
+                    and sub.type in ("batch_norm", "dropout"):
+                # a for_test clone flips is_test on the FUSED op only
+                sub = Operator(sub.block, sub.type, dict(sub.inputs),
+                               dict(sub.outputs),
+                               {**sub.attrs, "is_test": True})
+            run_op(env, sub)
+        return
+
+    scale = get(env, op.input("Scale"))
+    bias = get(env, op.input("Bias"))
+    mean = get(env, op.input("Mean"))
+    var = get(env, op.input("Variance"))
+    if residual is not None and residual.dtype != x.dtype:
+        from ..op_registry import amp_harmonize
+        _, residual = amp_harmonize(x, residual)
+    y, mean_out, var_out, saved_mean, saved_var = \
+        fused_conv.fused_conv_bn_act(
+            x, w, scale, bias, mean, var, strides=strides, paddings=pads,
+            eps=op.attr("epsilon", 1e-5), momentum=op.attr("momentum", 0.9),
+            act=op.attr("act"), residual=residual, is_test=is_test,
+            use_global_stats=use_global)
+    put(env, op.output("Y"), y)
+    put(env, op.output("MeanOut"), mean_out)
+    put(env, op.output("VarianceOut"), var_out)
+    if saved_mean is not None:
+        put(env, op.output("SavedMean"), saved_mean)
+        put(env, op.output("SavedVariance"), saved_var)
+
+
 # ---------------- normalization ----------------
 
 @register("batch_norm")

@@ -604,6 +604,50 @@ def _pool2d_shape(ctx, op):
              dim(xs[3], ksize[1], pads[1], strides[1])), dt)
 
 
+@register_shape("fused_conv2d")
+def _fused_conv2d_shape(ctx, op):
+    """conv2d+batch_norm(+add)(+relu) chain fused by
+    ``core/epilogue_fusion.py``: conv output geometry, BN channel-vector
+    checks, and the residual must match the conv output shape."""
+    xs = ctx.shape(op.input("Input"))
+    ws = ctx.shape(op.input("Filter"))
+    dt = ctx.dtype(op.input("Input"))
+    out_shape = None
+    if xs is not None and ws is not None and len(xs) == 4 and len(ws) == 4:
+        strides = _pair(op.attr("strides", [1, 1]))
+        pads = _pair(op.attr("paddings", [0, 0]))
+        dil = _pair(op.attr("dilations", [1, 1]))
+        groups = op.attr("groups", 1) or 1
+        if xs[1] != -1 and ws[1] != -1 and ws[1] * groups != xs[1]:
+            raise ShapeError(
+                "in-channels mismatch: input '%s' has C=%d but filter '%s' "
+                "is %s with groups=%d (needs C = %d)"
+                % (op.input("Input").name, xs[1], op.input("Filter").name,
+                   list(ws), groups, ws[1] * groups))
+        oh = _conv_dim(xs[2], ws[2], pads[0], strides[0], dil[0])
+        ow = _conv_dim(xs[3], ws[3], pads[1], strides[1], dil[1])
+        out_shape = (xs[0], ws[0], oh, ow)
+    ctx.set(op.output("Y"), out_shape, dt)
+    c = ws[0] if ws is not None else -1
+    for slot in ("Scale", "Bias", "Mean", "Variance"):
+        v = op.input(slot)
+        s = ctx.shape(v)
+        if v is not None and s is not None and c != -1 and tuple(s) != (c,):
+            raise ShapeError(
+                "fused_conv2d %s '%s' has shape %s but the channel dim is "
+                "%d" % (slot, v.name, list(s), c))
+    rv = op.input("Residual")
+    rs = ctx.shape(rv) if rv is not None else None
+    if rs is not None and out_shape is not None:
+        known = all(a == b or -1 in (a, b) for a, b in zip(rs, out_shape))
+        if len(rs) != 4 or not known:
+            raise ShapeError(
+                "fused_conv2d Residual '%s' has shape %s but the conv "
+                "output is %s" % (rv.name, list(rs), list(out_shape)))
+    for slot in ("MeanOut", "VarianceOut", "SavedMean", "SavedVariance"):
+        ctx.set(op.output(slot), (c,) if c != -1 else None, None)
+
+
 @register_shape("batch_norm")
 def _batch_norm_shape(ctx, op):
     xs = ctx.shape(op.input("X"))
@@ -687,14 +731,15 @@ def _top_k_shape(ctx, op):
         raise ShapeError("top_k k=%d exceeds last dim of %s" % (k, list(xs)))
     out = tuple(xs[:-1]) + (k,)
     ctx.set(op.output("Out"), out, ctx.dtype(op.input("X")))
-    ctx.set(op.output("Indices"), out, np.dtype(np.int64))
+    # int32: the lowering emits int32 indices (x64 is off — see math_ops)
+    ctx.set(op.output("Indices"), out, np.dtype(np.int32))
 
 
 @register_shape("argmax", "argmin")
 def _arg_shape(ctx, op):
     xs = ctx.shape(op.input("X"))
     if xs is None:
-        ctx.set(op.output("Out"), None, np.dtype(np.int64))
+        ctx.set(op.output("Out"), None, np.dtype(np.int32))
         return
     axis = _norm_axis(op.attr("axis", -1), len(xs))
-    ctx.set(op.output("Out"), xs[:axis] + xs[axis + 1:], np.dtype(np.int64))
+    ctx.set(op.output("Out"), xs[:axis] + xs[axis + 1:], np.dtype(np.int32))

@@ -665,7 +665,7 @@ def topk(input, k, name=None):
     values = helper.create_variable_for_type_inference(
         dtype=_dtype(input), shape=out_shape)
     indices = helper.create_variable_for_type_inference(
-        dtype="int64", shape=out_shape, stop_gradient=True)
+        dtype="int32", shape=out_shape, stop_gradient=True)
     helper.append_op("top_k", {"X": input},
                      {"Out": values, "Indices": indices}, {"k": k})
     return values, indices
@@ -674,13 +674,13 @@ def topk(input, k, name=None):
 def argmax(x, axis=0, name=None):
     shape = tuple(s for i, s in enumerate(x.shape) if i != axis % len(x.shape))
     return _unary_layer("argmax", x, {"axis": axis}, name, out_shape=shape,
-                        out_dtype="int64")
+                        out_dtype="int32")
 
 
 def argmin(x, axis=0, name=None):
     shape = tuple(s for i, s in enumerate(x.shape) if i != axis % len(x.shape))
     return _unary_layer("argmin", x, {"axis": axis}, name, out_shape=shape,
-                        out_dtype="int64")
+                        out_dtype="int32")
 
 
 def argsort(input, axis=-1, name=None):
@@ -688,7 +688,7 @@ def argsort(input, axis=-1, name=None):
     out = helper.create_variable_for_type_inference(dtype=_dtype(input),
                                                     shape=input.shape)
     ids = helper.create_variable_for_type_inference(
-        dtype="int64", shape=input.shape, stop_gradient=True)
+        dtype="int32", shape=input.shape, stop_gradient=True)
     helper.append_op("argsort", {"X": input},
                      {"Out": out, "Indices": ids}, {"axis": axis})
     return out, ids
@@ -962,7 +962,7 @@ def crf_decoding(input, param_attr, label=None, length=None, name=None):
         transition = helper.create_parameter(
             helper.param_attr, shape=[size + 2, size], dtype=_dtype(input))
     out = helper.create_variable_for_type_inference(
-        dtype="int64", shape=tuple(input.shape[:2]))
+        dtype="int32", shape=tuple(input.shape[:2]))
     inputs = {"Emission": input, "Transition": transition}
     if label is not None:
         inputs["Label"] = label

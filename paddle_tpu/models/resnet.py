@@ -57,7 +57,10 @@ def resnet_imagenet(depth=50, class_num=1000, image_shape=(3, 224, 224)):
            152: ([3, 8, 36, 3], _bottleneck)}
     stages, block_fn = cfg[depth]
     img = layers.data("img", shape=list(image_shape), dtype="float32")
-    label = layers.data("label", shape=[1], dtype="int64")
+    # int32 on purpose (TPU-native): jax without x64 truncates int64 feeds
+    # to int32 anyway, emitting a UserWarning on every bench step — request
+    # the effective dtype instead of relying on silent truncation
+    label = layers.data("label", shape=[1], dtype="int32")
     x = _conv_bn(img, 64, 7, 2, act="relu")
     x = layers.pool2d(x, pool_size=3, pool_stride=2, pool_padding=1,
                       pool_type="max")
@@ -71,7 +74,7 @@ def resnet_imagenet(depth=50, class_num=1000, image_shape=(3, 224, 224)):
     return ModelSpec(
         loss,
         feeds={"img": FeedSpec(list(image_shape), "float32", -1.0, 1.0),
-               "label": FeedSpec([1], "int64", 0, class_num)},
+               "label": FeedSpec([1], "int32", 0, class_num)},
         fetches={"acc": acc},
         flops_per_example=resnet50_flops(image_shape) if depth == 50 else None)
 
@@ -81,7 +84,7 @@ def resnet_cifar10(depth=32, class_num=10):
     assert (depth - 2) % 6 == 0
     n = (depth - 2) // 6
     img = layers.data("img", shape=[3, 32, 32], dtype="float32")
-    label = layers.data("label", shape=[1], dtype="int64")
+    label = layers.data("label", shape=[1], dtype="int32")
     x = _conv_bn(img, 16, 3, 1, act="relu")
     x = _layer_warp(_basicblock, x, 16, n, 1)
     x = _layer_warp(_basicblock, x, 32, n, 2)
@@ -93,7 +96,7 @@ def resnet_cifar10(depth=32, class_num=10):
     return ModelSpec(
         loss,
         feeds={"img": FeedSpec([3, 32, 32], "float32", -1.0, 1.0),
-               "label": FeedSpec([1], "int64", 0, class_num)},
+               "label": FeedSpec([1], "int32", 0, class_num)},
         fetches={"acc": acc})
 
 

@@ -547,6 +547,444 @@ def _flash_bwd_impl(q, k, v, bias, seed, causal, scale, dropout_rate,
 
 
 # ---------------------------------------------------------------------------
+# packed STREAMING kernels — [B, T, H*D] layout, heads looped in-kernel
+# ---------------------------------------------------------------------------
+#
+# The head-split streaming path below reshapes [B,T,H*D] -> [B*H,T,D] around
+# the custom calls, and XLA materializes those relayouts as real HBM copies
+# (~36 ms/step at the seq-2048 bench config — NOTES_r5.md; 7 copies per
+# attention site). These kernels keep the packed layout the projection
+# matmuls produce END TO END: the grid stays (batch, block), each program
+# loops the heads over static lane slices (like the dense kernels), and the
+# online-softmax k-loop streams K/V blocks exactly as the head-split
+# kernels do. The price is VMEM: K/V (fwd) and q/do/dq-f32 (bwd) are
+# full-T refs of width H*D rather than D, which caps the single-chip
+# packed path near T~2-3k for transformer-base — precisely the bench
+# regime; longer contexts keep the head-split path (gate:
+# _packed_stream_fits; PADDLE_TPU_SPLIT_STREAM=1 forces the old path for
+# A/B).
+
+def _packed_fwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, o_ref,
+                       lse_ref, *, num_heads, block_k, causal, scale,
+                       kv_len, dropout_rate):
+    from jax.experimental import pallas as pl
+
+    block_q, hd = q_ref.shape
+    d = hd // num_heads
+    kv_pad = k_ref.shape[0]
+    b_idx = pl.program_id(0)
+    q_idx = pl.program_id(1)
+
+    num_kb = kv_pad // block_k
+    if causal:
+        num_kb = jnp.minimum(
+            num_kb, ((q_idx + 1) * block_q + block_k - 1) // block_k)
+    kv_partial = kv_len < kv_pad
+    mask_lo = _kv_mask_lo(num_kb, q_idx, block_q, block_k, kv_len,
+                          kv_pad, causal)
+
+    for h in range(num_heads):
+        sl = pl.dslice(h * d, d)
+        q = q_ref[:, sl]
+        # same transposed-scores online softmax as _fwd_kernel, with K/V
+        # loads lane-sliced to this head's columns (no HBM relayout)
+        m_i = jnp.full((1, block_q), -jnp.inf, jnp.float32)
+        l_i = jnp.zeros((1, block_q), jnp.float32)
+        acc = jnp.zeros((d, block_q), jnp.float32)
+
+        def make_body(masked):
+            def body(kb, carry):
+                m_i, l_i, acc = carry
+                ksl = pl.dslice(kb * block_k, block_k)
+                k = k_ref[ksl, sl]
+                v = v_ref[ksl, sl]
+                st = jax.lax.dot_general(
+                    k, q, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if bias_ref is not None:
+                    bb = bias_ref[0, ksl]
+                    st = st + bb.astype(jnp.float32)[:, None]
+                if masked:
+                    mask = _kv_mask(kb, q_idx, block_q, block_k, kv_len,
+                                    kv_pad, causal)
+                    st = jnp.where(mask, st, -jnp.inf)
+                m_new = jnp.maximum(m_i, jnp.max(st, axis=0, keepdims=True))
+                m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+                p = jnp.exp(st - m_safe)
+                alpha = jnp.where(jnp.isfinite(m_i),
+                                  jnp.exp(m_i - m_safe), 0.0)
+                l_new = alpha * l_i + jnp.sum(p, axis=0, keepdims=True)
+                p_use = p
+                if dropout_rate > 0.0:
+                    keep = _dropout_keep(
+                        (block_k, block_q), dropout_rate, seed_ref[0, 0],
+                        (b_idx * num_heads + h, q_idx, kb))
+                    p_use = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+                acc_new = acc * alpha + jax.lax.dot_general(
+                    v, p_use.astype(v.dtype), (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l_new, acc_new
+            return body
+
+        carry = (m_i, l_i, acc)
+        if causal or kv_partial:
+            carry = jax.lax.fori_loop(0, mask_lo, make_body(False), carry)
+            carry = jax.lax.fori_loop(mask_lo, num_kb, make_body(True),
+                                      carry)
+        else:
+            carry = jax.lax.fori_loop(0, num_kb, make_body(False), carry)
+        m_i, l_i, acc = carry
+        l_safe = jnp.maximum(l_i, 1e-30)
+        o_ref[:, sl] = (acc / l_safe).T.astype(o_ref.dtype)
+        lse = jnp.where(jnp.isfinite(m_i), m_i + jnp.log(l_safe), -jnp.inf)
+        lse_ref[h, pl.dslice(q_idx * block_q, block_q)] = \
+            lse[0].astype(jnp.float32)
+
+
+def _packed_bwd_kernel(q_ref, k_ref, v_ref, bias_ref, seed_ref, do_ref,
+                       lse_ref, delta_ref, dk_ref, dv_ref, db_ref, dq_ref,
+                       *, num_heads, block_q, causal, scale, kv_len, kv_pad,
+                       q_len, dropout_rate):
+    from jax.experimental import pallas as pl
+
+    block_k, hd = k_ref.shape
+    d = hd // num_heads
+    q_pad = q_ref.shape[0]
+    b_idx = pl.program_id(0)
+    k_idx = pl.program_id(1)
+
+    bias_blk = None
+    if bias_ref is not None:
+        bias_blk = bias_ref[0, pl.dslice(k_idx * block_k, block_k)]
+
+    # dq accumulates into the SAME revisited full-T packed buffer for
+    # every k step (cf. _bwd_dkv_kernel); zero it on the first
+    @pl.when(k_idx == 0)
+    def _init_dq():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    kvalid = None
+    if kv_len < kv_pad:
+        kvalid = (k_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_k, 1), 0)) < kv_len
+
+    qb_end = q_pad // block_q
+    qb_lo = (k_idx * block_k) // block_q if causal else 0
+    q_partial = q_len < q_pad
+    db_total = (jnp.zeros((block_k, 1), jnp.float32)
+                if db_ref is not None else None)
+
+    for h in range(num_heads):
+        sl = pl.dslice(h * d, d)
+        k = k_ref[:, sl]
+        v = v_ref[:, sl]
+
+        def make_body(masked):
+            def body(qb, carry):
+                dk, dv, db = carry
+                qsl = pl.dslice(qb * block_q, block_q)
+                q = q_ref[qsl, sl]
+                do = do_ref[qsl, sl]
+                lse = lse_ref[h, qsl]
+                delta = delta_ref[h, qsl]
+                st = jax.lax.dot_general(
+                    k, q, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if bias_blk is not None:
+                    st = st + bias_blk.astype(jnp.float32)[:, None]
+                lse_okf = jnp.isfinite(lse).astype(jnp.float32)
+                lse_safe = jnp.where(jnp.isfinite(lse), lse, 0.0)
+                p = jnp.exp(st - lse_safe[None, :]) * lse_okf[None, :]
+                if kvalid is not None:
+                    p = jnp.where(kvalid, p, 0.0)
+                if masked:
+                    q_pos = qb * block_q + jax.lax.broadcasted_iota(
+                        jnp.int32, (block_k, block_q), 1)
+                    mask = q_pos < q_len if q_len < q_pad else None
+                    if causal:
+                        k_pos = k_idx * block_k + jax.lax.broadcasted_iota(
+                            jnp.int32, (block_k, block_q), 0)
+                        keep = q_pos >= k_pos
+                        mask = keep if mask is None else mask & keep
+                    if mask is not None:
+                        p = jnp.where(mask, p, 0.0)
+                dp = jax.lax.dot_general(
+                    v, do, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                p_drop = p
+                if dropout_rate > 0.0:
+                    keep = _dropout_keep(
+                        (block_k, block_q), dropout_rate, seed_ref[0, 0],
+                        (b_idx * num_heads + h, qb, k_idx))
+                    inv = 1.0 / (1.0 - dropout_rate)
+                    p_drop = jnp.where(keep, p * inv, 0.0)
+                    dp = jnp.where(keep, dp * inv, 0.0)
+                ds = p * (dp - delta[None, :])
+                dv = dv + jax.lax.dot_general(
+                    p_drop.astype(v.dtype), do.astype(v.dtype),
+                    (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                dk = dk + jax.lax.dot_general(
+                    ds.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                if db is not None:
+                    db = db + jax.lax.dot_general(
+                        ds, jnp.ones((1, block_q), jnp.float32),
+                        (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32)
+                dq_ref[qsl, sl] += jax.lax.dot_general(
+                    ds.astype(k.dtype), k, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32) * scale
+                return dk, dv, db
+            return body
+
+        carry = (jnp.zeros((block_k, d), jnp.float32),
+                 jnp.zeros((block_k, d), jnp.float32),
+                 jnp.zeros((block_k, 1), jnp.float32)
+                 if db_ref is not None else None)
+        if causal or q_partial:
+            if causal:
+                first_full = (k_idx * block_k + block_k - 1
+                              + block_q - 1) // block_q
+                a_hi = jnp.minimum(first_full, qb_end)
+            else:
+                a_hi = qb_lo
+            pad_lo = (q_len // block_q) if q_partial else qb_end
+            b_hi = jnp.maximum(a_hi, jnp.minimum(pad_lo, qb_end))
+            carry = jax.lax.fori_loop(qb_lo, a_hi, make_body(True), carry)
+            carry = jax.lax.fori_loop(a_hi, b_hi, make_body(False), carry)
+            carry = jax.lax.fori_loop(b_hi, qb_end, make_body(True), carry)
+        else:
+            carry = jax.lax.fori_loop(qb_lo, qb_end, make_body(False),
+                                      carry)
+        dk, dv, db = carry
+        dk_ref[:, sl] = dk.astype(dk_ref.dtype)
+        dv_ref[:, sl] = dv.astype(dv_ref.dtype)
+        if db_total is not None:
+            db_total = db_total + db  # bias is shared across heads
+    if db_ref is not None:
+        db_ref[0, pl.dslice(k_idx * block_k, block_k)] = \
+            db_total[:, 0].astype(db_ref.dtype)
+
+
+def _packed_stream_fwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
+                            dropout_rate):
+    """q,k,v: packed [B, T, H*D]; bias [B, Tk] or None.
+    Returns (out [B, T, H*D], lse [B, nh_pad, T])."""
+    from jax.experimental import pallas as pl
+
+    b, t, hd = q.shape
+    t_k = k.shape[1]
+    block_q, block_k = _block_sizes(t, t_k)
+    qp, kp, vp = _pad_t(q, block_q), _pad_t(k, block_k), _pad_t(v, block_k)
+    t_pad, tk_pad = qp.shape[1], kp.shape[1]
+    nh_pad = max(num_heads, 8)
+
+    kernel = functools.partial(
+        _packed_fwd_kernel, num_heads=num_heads, block_k=block_k,
+        causal=causal, scale=scale, kv_len=t_k, dropout_rate=dropout_rate)
+    in_specs = [
+        pl.BlockSpec((None, block_q, hd), lambda b, qi: (b, qi, 0)),
+        pl.BlockSpec((None, tk_pad, hd), lambda b, qi: (b, 0, 0)),
+        pl.BlockSpec((None, tk_pad, hd), lambda b, qi: (b, 0, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias is not None:
+        in_specs.append(pl.BlockSpec((None, 8, tk_pad),
+                                     lambda b, qi: (b, 0, 0)))
+        bp = _pad_vec(bias, block_k)
+        args.append(jnp.broadcast_to(bp[:, None, :], (b, 8, tk_pad)))
+    in_specs.append(pl.BlockSpec((1, 1), lambda b, qi: (0, 0)))
+    args.append(jnp.asarray([[seed]], jnp.uint32))
+
+    def entry(*refs):
+        if bias is not None:
+            q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, l_ref = refs
+        else:
+            q_ref, k_ref, v_ref, s_ref, o_ref, l_ref = refs
+            b_ref = None
+        kernel(q_ref, k_ref, v_ref, b_ref, s_ref, o_ref, l_ref)
+
+    out, lse = pl.pallas_call(
+        entry,
+        grid=(b, t_pad // block_q),
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((None, block_q, hd), lambda b, qi: (b, qi, 0)),
+            pl.BlockSpec((None, nh_pad, t_pad), lambda b, qi: (b, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t_pad, hd), q.dtype),
+            jax.ShapeDtypeStruct((b, nh_pad, t_pad), jnp.float32),
+        ],
+        interpret=_INTERPRET,
+    )(*args)
+    return out[:, :t], lse[:, :, :t]
+
+
+def _packed_stream_bwd_impl(q, k, v, bias, seed, num_heads, causal, scale,
+                            dropout_rate, out, lse, do):
+    from jax.experimental import pallas as pl
+
+    b, t, hd = q.shape
+    t_k = k.shape[1]
+    d = hd // num_heads
+    block_q, block_k = _block_sizes(t, t_k, bwd=(dropout_rate == 0.0))
+    qp, kp, vp = _pad_t(q, block_q), _pad_t(k, block_k), _pad_t(v, block_k)
+    dop = _pad_t(do, block_q)
+    t_pad, tk_pad = qp.shape[1], kp.shape[1]
+    nh_pad = lse.shape[1]
+    # per-(b, h, t) delta = rowsum_d(do * o) over this head's lanes; the
+    # [B,T,H] reduce + transpose is tiny next to the old full [B,T,H,D]
+    # relayouts
+    prod = jnp.sum(
+        (do.astype(jnp.float32) * out.astype(jnp.float32)).reshape(
+            b, t, num_heads, d), axis=-1)
+    delta = prod.transpose(0, 2, 1)  # [B, H, T]
+
+    def pad_stats(x):  # [B, nh?, T] -> [B, nh_pad, T_pad]
+        if x.shape[1] < nh_pad:
+            x = jnp.pad(x, ((0, 0), (0, nh_pad - x.shape[1]), (0, 0)))
+        r = (-x.shape[2]) % block_q
+        return jnp.pad(x, ((0, 0), (0, 0), (0, r))) if r else x
+
+    lsep = pad_stats(lse)
+    deltap = pad_stats(delta)
+    if bias is not None:
+        bp = _pad_vec(bias, block_k)
+        biasp = jnp.broadcast_to(bp[:, None, :], (b, 8, bp.shape[1]))
+    else:
+        biasp = None
+
+    kernel = functools.partial(
+        _packed_bwd_kernel, num_heads=num_heads, block_q=block_q,
+        causal=causal, scale=scale, kv_len=t_k, kv_pad=tk_pad, q_len=t,
+        dropout_rate=dropout_rate)
+
+    def entry(*refs):
+        if biasp is not None:
+            (q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, l_ref, de_ref,
+             dk_ref, dv_ref, db_ref, dq_ref) = refs
+        else:
+            (q_ref, k_ref, v_ref, s_ref, do_ref, l_ref, de_ref,
+             dk_ref, dv_ref, dq_ref) = refs
+            b_ref = db_ref = None
+        kernel(q_ref, k_ref, v_ref, b_ref, s_ref, do_ref, l_ref, de_ref,
+               dk_ref, dv_ref, db_ref, dq_ref)
+
+    in_specs = [
+        pl.BlockSpec((None, t_pad, hd), lambda b, ki: (b, 0, 0)),
+        pl.BlockSpec((None, block_k, hd), lambda b, ki: (b, ki, 0)),
+        pl.BlockSpec((None, block_k, hd), lambda b, ki: (b, ki, 0)),
+    ]
+    args = [qp, kp, vp]
+    if biasp is not None:
+        in_specs.append(pl.BlockSpec((None, 8, tk_pad),
+                                     lambda b, ki: (b, 0, 0)))
+        args.append(biasp)
+    in_specs.append(pl.BlockSpec((1, 1), lambda b, ki: (0, 0)))
+    args.append(jnp.asarray([[seed]], jnp.uint32))
+    in_specs += [
+        pl.BlockSpec((None, t_pad, hd), lambda b, ki: (b, 0, 0)),
+        pl.BlockSpec((None, nh_pad, t_pad), lambda b, ki: (b, 0, 0)),
+        pl.BlockSpec((None, nh_pad, t_pad), lambda b, ki: (b, 0, 0)),
+    ]
+    args += [dop, lsep, deltap]
+    out_specs = [
+        pl.BlockSpec((None, block_k, hd), lambda b, ki: (b, ki, 0)),
+        pl.BlockSpec((None, block_k, hd), lambda b, ki: (b, ki, 0)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((b, tk_pad, hd), k.dtype),
+        jax.ShapeDtypeStruct((b, tk_pad, hd), v.dtype),
+    ]
+    if biasp is not None:
+        out_specs.append(pl.BlockSpec((None, 8, tk_pad),
+                                      lambda b, ki: (b, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((b, 8, tk_pad), jnp.float32))
+    out_specs.append(pl.BlockSpec((None, t_pad, hd),
+                                  lambda b, ki: (b, 0, 0)))
+    out_shape.append(jax.ShapeDtypeStruct((b, t_pad, hd), jnp.float32))
+    res = pl.pallas_call(
+        entry,
+        grid=(b, tk_pad // block_k),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=_INTERPRET,
+    )(*args)
+    if biasp is not None:
+        dk, dv, db, dq = res
+        db = db[:, 0, :t_k]
+    else:
+        dk, dv, dq = res
+        db = None
+    return dq[:, :t].astype(q.dtype), dk[:, :t_k], dv[:, :t_k], db
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _packed_stream_attention(q, k, v, bias, seed, num_heads, causal, scale,
+                             dropout_rate):
+    out, _ = _packed_stream_fwd_impl(q, k, v, bias, seed, num_heads, causal,
+                                     scale, dropout_rate)
+    return out
+
+
+def _packed_stream_fwd(q, k, v, bias, seed, num_heads, causal, scale,
+                       dropout_rate):
+    out, lse = _packed_stream_fwd_impl(q, k, v, bias, seed, num_heads,
+                                       causal, scale, dropout_rate)
+    return out, (q, k, v, bias, seed, out, lse)
+
+
+def _packed_stream_bwd(num_heads, causal, scale, dropout_rate, res, g):
+    q, k, v, bias, seed, out, lse = res
+    dq, dk, dv, db = _packed_stream_bwd_impl(
+        q, k, v, bias, seed, num_heads, causal, scale, dropout_rate, out,
+        lse, g)
+    dbias = db.astype(bias.dtype) if bias is not None else None
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias, None)
+
+
+_packed_stream_attention.defvjp(_packed_stream_fwd, _packed_stream_bwd)
+
+_PACKED_STREAM = True  # module A/B switch (tests also flip it)
+# ~16 MB VMEM/core; the estimate below is conservative already (the
+# revisited dq and the constant-index q/do/K/V refs are NOT
+# double-buffered by Mosaic), so leave only headroom for transients.
+# bf16 seq-2048 transformer-base lands at ~12.8 MB — inside the gate by
+# design (that bench config is what this path exists for).
+_STREAM_VMEM_BUDGET = 13 * 1024 * 1024
+
+
+def _packed_stream_fits(t, t_k, hd, esize, num_heads, dropout=0.0):
+    """Conservative VMEM bound for the packed streaming kernels: the bwd
+    is the larger step — full-T q/do (+f32 dq accumulator) plus the
+    double-buffered K/V/dK/dV blocks and the stats rows. The bwd estimate
+    uses the geometry the backward will ACTUALLY allocate: the
+    PADDLE_TPU_FLASH_BLOCK_BWD override engages only when dropout is off
+    (fwd/bwd must share block geometry for mask regeneration), so the
+    gate mirrors _packed_stream_bwd_impl's ``bwd=(dropout == 0.0)``."""
+    block_q, block_k = _block_sizes(t, t_k)
+    bq_b, bk_b = _block_sizes(t, t_k, bwd=(dropout == 0.0))
+    nh_pad = max(num_heads, 8)
+
+    def pad(x, m):
+        return ((x + m - 1) // m) * m
+
+    fwd = (2 * pad(t_k, block_k) * hd * esize   # K/V resident
+           + 4 * block_q * hd * esize           # q/o double-buffered
+           + nh_pad * pad(t, block_q) * 4)
+    t_pad_b = pad(t, bq_b)
+    bwd = (2 * t_pad_b * hd * esize             # q/do resident
+           + t_pad_b * hd * 4                   # f32 dq accumulator
+           + 8 * bk_b * hd * esize              # k/v/dk/dv double-buffered
+           + 3 * nh_pad * t_pad_b * 4)
+    return max(fwd, bwd) <= _STREAM_VMEM_BUDGET
+
+
+# ---------------------------------------------------------------------------
 # dense short-sequence kernels — packed [B, T, H*D] layout, whole-sequence
 # blocks resident in VMEM
 # ---------------------------------------------------------------------------
@@ -967,16 +1405,28 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
         return _dense_attention(q, k, v, key_bias, seed, num_heads, causal,
                                 scale, float(dropout_rate))
 
-    def split(x, t_):
-        return x.reshape(b, t_, num_heads, d).transpose(0, 2, 1, 3)
-
-    qh, kh, vh = split(q, t), split(k, t_k), split(v, t_k)
-
     # the streaming kernels anchor the causal diagonal at position 0
     # (q_pos >= k_pos) while mha_reference anchors it at the sequence END
     # (tril k=t_k-t_q); for t_q != t_k they disagree, so only the square
     # case takes the kernel
     pallas_ok = pallas_ok and (not causal or t == t_k)
+
+    from ..core.op_registry import env_flag
+
+    if (pallas_ok and _PACKED_STREAM
+            and not env_flag("PADDLE_TPU_SPLIT_STREAM")
+            and _packed_stream_fits(t, t_k, hd, q.dtype.itemsize,
+                                    num_heads, float(dropout_rate))):
+        # copy-free streaming path: the packed layout goes straight into
+        # the kernels — no [B,T,H,D] head-split relayouts around the
+        # custom calls (the ~36 ms/step at the seq-2048 bench config)
+        return _packed_stream_attention(q, k, v, key_bias, seed, num_heads,
+                                        causal, scale, float(dropout_rate))
+
+    def split(x, t_):
+        return x.reshape(b, t_, num_heads, d).transpose(0, 2, 1, 3)
+
+    qh, kh, vh = split(q, t), split(k, t_k), split(v, t_k)
 
     if not pallas_ok:
         # dropout applies to the attention weights, matching the kernels

@@ -355,3 +355,116 @@ def test_cli_exits_zero_on_zoo_subset():
 def test_cli_exits_zero_on_full_zoo():
     p = _run_cli("--zoo", "-q")
     assert p.returncode == 0, p.stdout + p.stderr
+
+
+# ---------------------------------------------------------------------------
+# epilogue fusion as a verifier citizen (ISSUE 12): fused programs verify
+# with zero findings, and the rewrite refuses unsafe chains with
+# provenance pointing HERE
+# ---------------------------------------------------------------------------
+
+def _conv_bn_model():
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.unique_name.switch()
+        img = fluid.layers.data("img", shape=[4, 8, 8], dtype="float32")
+        label = fluid.layers.data("label", shape=[1], dtype="int32")
+        x = fluid.layers.conv2d(img, 8, 1, bias_attr=False)
+        x = fluid.layers.batch_norm(x, act="relu")
+        short = x
+        y = fluid.layers.conv2d(x, 8, 3, padding=1, bias_attr=False)
+        y = fluid.layers.batch_norm(y)
+        out = fluid.layers.elementwise_add(short, y, act="relu")
+        out = fluid.layers.pool2d(out, pool_type="avg",
+                                  global_pooling=True)
+        logits = fluid.layers.fc(out, size=4)
+        loss = fluid.layers.mean(
+            fluid.layers.softmax_with_cross_entropy(logits, label))
+        fluid.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+    return main, startup, loss
+
+
+def test_fused_program_verifies_clean():
+    """fuse_program output passes every analysis check with ZERO findings
+    — shape rule, dataflow, dead-op lint (absorbed intermediates are
+    dropped from the symbol table)."""
+    from paddle_tpu.core.epilogue_fusion import fuse_program
+
+    main, startup, loss = _conv_bn_model()
+    fused, report = fuse_program(main, protected=[loss.name])
+    assert report.fused, "expected at least one fused chain"
+    kinds = {site.kinds for site in report.fused}
+    assert ("conv2d", "batch_norm", "elementwise_add", "relu") in kinds
+    res = analysis.analyze_program(
+        fused, feed_names=["img", "label"], fetch_names=[loss.name])
+    assert not res.diagnostics, res.report()
+
+
+def test_fusion_refuses_shared_intermediate_with_provenance():
+    """A conv output consumed by anything besides its batch_norm must NOT
+    fuse — and the refusal names the extra consumer with the user line
+    that created the op (this file)."""
+    from paddle_tpu.core.epilogue_fusion import fuse_ops
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.unique_name.switch()
+        img = fluid.layers.data("img", shape=[4, 8, 8], dtype="float32")
+        co = fluid.layers.conv2d(img, 8, 1, bias_attr=False)
+        bn = fluid.layers.batch_norm(co, act="relu")
+        spy = fluid.layers.reduce_sum(co)  # second consumer of conv out
+        out = fluid.layers.elementwise_add(
+            fluid.layers.reduce_sum(bn), spy)
+    ops = list(main.global_block().ops)
+    new_ops, report = fuse_ops(ops, protected=[out.name])
+    assert not report.fused
+    assert report.refused, "expected a recorded refusal"
+    msg = str(report.refused[0])
+    assert "consumers" in msg
+    assert "test_analysis.py" in msg  # provenance: the spy op's callsite
+    assert [o.type for o in new_ops] == [o.type for o in ops]
+
+
+def test_fusion_respects_fetched_intermediate():
+    """A fetched (protected) conv output is never absorbed."""
+    from paddle_tpu.core.epilogue_fusion import fuse_ops
+
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.unique_name.switch()
+        img = fluid.layers.data("img", shape=[4, 8, 8], dtype="float32")
+        co = fluid.layers.conv2d(img, 8, 1, bias_attr=False)
+        fluid.layers.batch_norm(co, act="relu")
+    ops = list(main.global_block().ops)
+    new_ops, report = fuse_ops(ops, protected=[co.name])
+    assert not report.fused
+    assert any("protected" in str(r) for r in report.refused)
+
+
+def test_fused_op_shape_rule_catches_bad_channel_vector():
+    """The fused_conv2d infer-shape rule is a first-class citizen: a
+    Scale vector that disagrees with the filter's out-channels is a
+    build-time error with provenance."""
+    main = fluid.Program()
+    gb = main.global_block()
+    x = gb.create_var(name="x", shape=[2, 8, 8, 8], dtype="float32")
+    w = gb.create_parameter(name="w", shape=[16, 8, 1, 1],
+                            dtype="float32")
+    bad_scale = gb.create_parameter(name="s", shape=[8], dtype="float32")
+    bias = gb.create_parameter(name="b", shape=[16], dtype="float32")
+    mean = gb.create_parameter(name="m", shape=[16], dtype="float32")
+    var = gb.create_parameter(name="v", shape=[16], dtype="float32")
+    y = gb.create_var(name="y", shape=[2, 16, 8, 8], dtype="float32")
+    gb.append_op(
+        "fused_conv2d",
+        {"Input": x, "Filter": w, "Scale": bad_scale, "Bias": bias,
+         "Mean": mean, "Variance": var},
+        {"Y": y, "MeanOut": mean, "VarianceOut": var},
+        {"strides": [1, 1], "paddings": [0, 0], "dilations": [1, 1],
+         "groups": 1, "epsilon": 1e-5, "momentum": 0.9, "act": "relu",
+         "orig_ops": []})
+    res = analysis.analyze_program(main, feed_names=["x"],
+                                   fetch_names=["y"])
+    errs = [d for d in res.errors if d.check == "shape"]
+    assert errs and "Scale" in errs[0].message
+    assert "test_analysis.py" in str(errs[0])

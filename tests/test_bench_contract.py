@@ -71,6 +71,82 @@ def test_seq_override_metric_suffix(monkeypatch):
     assert seq == 128
 
 
+def _tiny_build(model, on_tpu, seq_override=None):
+    """A seconds-fast stand-in for bench._build that preserves the
+    record-assembly contract (metric/unit/flops/seq_len) so the
+    floor-constant tests can exercise the REAL _bench_static plumbing
+    without compiling the full configs."""
+    main = fluid.default_main_program()
+    x = fluid.layers.data("x", shape=[8])
+    label = fluid.layers.data("label", shape=[1], dtype="int32")
+    logits = fluid.layers.fc(x, size=4)
+    loss = fluid.layers.mean(
+        fluid.layers.softmax_with_cross_entropy(logits, label))
+    from paddle_tpu.models.common import FeedSpec, ModelSpec
+
+    spec = ModelSpec(loss,
+                     feeds={"x": FeedSpec([8]),
+                            "label": FeedSpec([1], "int32", 0, 4)},
+                     flops_per_example=1e5, tokens_per_example=8)
+    assert main is loss.block.program
+    seq_len = seq_override if model == "transformer" else None
+    name = {"resnet50": "resnet50_images_per_sec_per_chip",
+            "transformer": "transformer_base_seq%s_tokens_per_sec_per_chip"
+                           % seq_override}[model]
+    per_example = 8 if model == "transformer" else 1
+    return spec, 4, name, "x/sec", per_example, seq_len
+
+
+def test_resnet50_record_carries_rederived_ceiling(monkeypatch):
+    """ISSUE 12 floor pin: the resnet50 bench record must carry the HBM
+    ceiling constant SOURCED from CHIP_CEILING.json's matrix-derived
+    ``hbm_operative_gbs`` (never a hardcoded 552.2), plus the fusion
+    state that produced the number."""
+    import bench
+
+    ceil = bench._chip_ceiling()
+    assert ceil, "CHIP_CEILING.json missing"
+    assert "hbm_matrix" in ceil and "rmw" in ceil["hbm_matrix"], \
+        "ceiling record predates the copy/triad matrix re-derivation"
+    measured = [v for v in ceil["hbm_matrix"].values() if v is not None]
+    assert ceil["hbm_operative_gbs"] == max(measured), \
+        "operative rate must be the max over measured matrix entries"
+
+    monkeypatch.setattr(bench, "_build", _tiny_build)
+    monkeypatch.setenv("BENCH_STEPS", "1")
+    rec = bench._bench_static("resnet50", on_tpu=False)
+    cfg = rec["config"]
+    assert cfg["hbm_ceiling_source"] == "CHIP_CEILING.json"
+    assert cfg["hbm_gbs"] == ceil["hbm_operative_gbs"]
+    assert isinstance(cfg["fused_conv"], bool)
+    # the sourcing is live, not a copied literal
+    monkeypatch.setattr(bench, "_chip_ceiling",
+                        lambda: {"hbm_operative_gbs": 777.0})
+    rec2 = bench._bench_static("resnet50", on_tpu=False)
+    assert rec2["config"]["hbm_gbs"] == 777.0
+
+
+def test_seq2048_record_carries_stream_config(monkeypatch):
+    """The long-context record is self-describing about the streaming
+    path: flash block geometry + whether the packed copy-free path (vs
+    the legacy head-split one) produced the number."""
+    import bench
+
+    monkeypatch.setattr(bench, "_build", _tiny_build)
+    monkeypatch.setenv("BENCH_STEPS", "1")
+    monkeypatch.delenv("PADDLE_TPU_FLASH_BLOCK", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_SPLIT_STREAM", raising=False)
+    rec = bench._bench_static("transformer", on_tpu=False,
+                              seq_override=2048)
+    cfg = rec["config"]
+    assert cfg["flash_block"] == 512
+    assert cfg["packed_stream"] is True  # bf16 seq-2048 fits the gate
+    monkeypatch.setenv("PADDLE_TPU_SPLIT_STREAM", "1")
+    rec2 = bench._bench_static("transformer", on_tpu=False,
+                               seq_override=2048)
+    assert rec2["config"]["packed_stream"] is False
+
+
 def test_batch_rounding_warns(monkeypatch):
     """The transformer token-budget batch auto-scale must WARN when it
     rounds (ROADMAP item 5 standing bug: it used to round silently,

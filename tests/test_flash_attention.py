@@ -153,20 +153,24 @@ def test_flash_2d_and_broadcast_bias_fallback(rng):
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("packed", [False, True],
+                         ids=["head-split", "packed"])
 @pytest.mark.parametrize("causal,t,tk", [
-    (False, 200, 150),   # unaligned kv tail, multi-block both axes
-    (True, 200, 200),    # causal diagonal + unaligned tails
-    (False, 72, 200),    # q shorter than kv, kv tail masked
+    (False, 136, 104),   # unaligned kv tail, multi-block both axes
+    (True, 136, 136),    # causal diagonal + unaligned tails
+    (False, 72, 136),    # q shorter than kv, kv tail masked
 ])
-def test_flash_multiblock_unaligned_tails(rng, causal, t, tk,
+def test_flash_multiblock_unaligned_tails(rng, causal, t, tk, packed,
                                           monkeypatch):
     """Sequences spanning several blocks with t % block != 0 exercise the
     mask-specialized loop splits (unmasked interior / masked diagonal +
-    padded tails) in the three STREAMING kernels, fwd and bwd, with a key
-    bias. The dense-path ceiling is lowered so the block path engages at
-    these (interpret-tractable) lengths."""
+    padded tails) in BOTH streaming paths — the packed [B,T,H*D]
+    heads-in-kernel one and the legacy head-split one — fwd and bwd, with
+    a key bias. The dense-path ceiling is lowered so the block path
+    engages at these (interpret-tractable) lengths."""
     monkeypatch.setattr(fa, "_DENSE_MAX_Q", 0)
     monkeypatch.setattr(fa, "_DENSE_MAX_KV", 0)
+    monkeypatch.setattr(fa, "_PACKED_STREAM", packed)
     b, h, d = 1, 2, 8
     q, k, v = _mk(rng, b, h, t, tk, d)
     lengths = np.array([tk - 5])
@@ -193,6 +197,46 @@ def test_flash_multiblock_unaligned_tails(rng, causal, t, tk,
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-2, atol=1e-3,
                                    err_msg="d%s" % name)
+
+
+def test_packed_stream_matches_head_split(rng, monkeypatch):
+    """The packed streaming kernels agree with the head-split streaming
+    kernels (not just the reference) fwd+bwd at a multi-head,
+    multi-block, biased shape — the copy-free path is a pure layout
+    change."""
+    monkeypatch.setattr(fa, "_DENSE_MAX_Q", 0)
+    monkeypatch.setattr(fa, "_DENSE_MAX_KV", 0)
+    b, h, t, d = 2, 2, 72, 8
+    q, k, v = _mk(rng, b, h, t, t, d)
+    lengths = np.array([t - 7, t])
+    bias4 = np.where(np.arange(t)[None] < lengths[:, None], 0.0, -1e9)
+    bias4 = jnp.asarray(bias4[:, None, None, :].astype("f4"))
+
+    def loss(q, k, v):
+        o = fa.flash_attention(q, k, v, num_heads=h, bias=bias4,
+                               causal=True)
+        return jnp.sum(o * jnp.sin(o)), o
+
+    outs = {}
+    for packed in (False, True):
+        monkeypatch.setattr(fa, "_PACKED_STREAM", packed)
+        (l, o), g = jax.value_and_grad(loss, argnums=(0, 1, 2),
+                                       has_aux=True)(q, k, v)
+        outs[packed] = (np.asarray(o), [np.asarray(x) for x in g])
+    np.testing.assert_allclose(outs[True][0], outs[False][0],
+                               rtol=2e-4, atol=2e-4)
+    for a, b_, name in zip(outs[True][1], outs[False][1], "qkv"):
+        np.testing.assert_allclose(a, b_, rtol=2e-3, atol=2e-4,
+                                   err_msg="d%s" % name)
+
+
+def test_packed_stream_vmem_gate():
+    """The packed-stream gate declines shapes whose full-T packed refs
+    exceed the VMEM budget (those keep the head-split path) and accepts
+    the seq-2048 transformer-base bench geometry in bf16."""
+    assert fa._packed_stream_fits(2048, 2048, 512, 2, 8)   # bench config
+    assert not fa._packed_stream_fits(16384, 16384, 512, 2, 8)
+    assert not fa._packed_stream_fits(2048, 2048, 4096, 2, 32)
 
 
 def test_flash_causal_multiblock_grads(rng):

@@ -201,6 +201,26 @@ def _run_on_tpu():
     env.pop("JAX_PLATFORMS", None)
     env.pop("JAX_PLATFORM_NAME", None)
     env.pop("XLA_FLAGS", None)
+    # cheap platform probe FIRST: on a builder without a reachable TPU the
+    # plugin can spend many minutes in connection retries before jax falls
+    # back and the script prints its skip line — which used to cost the
+    # tier-1 suite ~460 s to skip 4 tests. A real bench chip initializes
+    # in seconds; PADDLE_TPU_PROBE_TIMEOUT raises the bound for slow
+    # tunnels.
+    probe_timeout = int(os.environ.get("PADDLE_TPU_PROBE_TIMEOUT", 120))
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0].platform)"],
+            cwd=_REPO, env=env, capture_output=True, text=True,
+            timeout=probe_timeout)
+    except subprocess.TimeoutExpired:
+        return {"skip": "tpu platform probe timed out after %ds"
+                        % probe_timeout}
+    lines = probe.stdout.strip().splitlines() if probe.stdout else []
+    platform = lines[-1] if lines else ""
+    if probe.returncode != 0 or platform != "tpu":
+        return {"skip": "no tpu (probe platform=%r)" % platform}
     proc = subprocess.run([sys.executable, "-c", _SCRIPT], cwd=_REPO,
                           env=env, capture_output=True, text=True,
                           timeout=540)

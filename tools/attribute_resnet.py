@@ -32,13 +32,29 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-from profile_bench import parse_xplane
+from profile_bench import parse_xplane, parse_xplane_bytes
 
 TRACE = "/tmp/jaxtrace-resnet50"
 HLO = "/tmp/resnet_hlo.txt"
 
-MATMUL_TFLOPS = 185.3e12     # CHIP_CEILING.json measured
-HBM_GBS = 552.2e9
+
+def _ceilings():
+    """Measured chip ceilings from the committed CHIP_CEILING.json —
+    floors are computed at the MATRIX-derived operative HBM rate (ISSUE
+    12: the single-pattern 552 GB/s figure is one row of the matrix, not
+    the ceiling), falling back to the legacy constants when absent.
+    Shares bench._chip_ceiling so the bench records and these floors can
+    never read different constants."""
+    from bench import _chip_ceiling
+
+    c = _chip_ceiling()
+    mm = (c.get("bf16_matmul_tflops") or 185.3) * 1e12
+    hbm = (c.get("hbm_operative_gbs") or c.get("hbm_stream_gbs")
+           or 552.2) * 1e9
+    return mm, hbm
+
+
+MATMUL_TFLOPS, HBM_GBS = _ceilings()
 
 
 def capture(steps, batch):
@@ -165,6 +181,8 @@ def floors(program, batch):
             if x is not None and x.shape is not None and len(x.shape) == 4:
                 res_bytes += 3 * batch * int(np.prod(x.shape[1:])) * e
 
+    bytes_total = (conv_fwd_bytes + conv_dx_bytes + conv_dw_bytes
+                   + 2 * act_pass + pool_bytes + adam_bytes + res_bytes)
     return {
         "conv-fwd": (fwd_comp / MATMUL_TFLOPS, conv_fwd_bytes / HBM_GBS),
         "conv-bwd-dx": (dx_comp / MATMUL_TFLOPS,
@@ -175,7 +193,7 @@ def floors(program, batch):
         "relu-elementwise": (0.0, res_bytes / HBM_GBS),
         "maxpool": (0.0, pool_bytes / HBM_GBS),
         "adam-update": (0.0, adam_bytes / HBM_GBS),
-    }, conv_flops
+    }, conv_flops, bytes_total
 
 
 BUCKETS = [
@@ -267,7 +285,7 @@ def main():
     else:
         main_prog, batch = capture(args.steps, args.batch)
 
-    fl, conv_flops = floors(main_prog, batch)
+    fl, conv_flops, model_bytes = floors(main_prog, batch)
 
     # profile join
     times = defaultdict(float)
@@ -326,13 +344,32 @@ def main():
           % (conv_flops / batch / 1e9, imgs,
              batch / (floor_total / 1e3)))
 
+    # cross-check the analytic bytes model against what the chip MOVED
+    # (ISSUE 12: a bytes model no profiler has confirmed is a guess)
+    per_op_bytes = parse_xplane_bytes(TRACE)
+    measured_bytes = (sum(per_op_bytes.values()) / steps
+                      if per_op_bytes else None)
+    print("   bytes/step: model %.2f GB, measured %s"
+          % (model_bytes / 1e9,
+             "%.2f GB (%.2fx model)" % (measured_bytes / 1e9,
+                                        measured_bytes / model_bytes)
+             if measured_bytes else
+             "n/a (no bytes-accessed stats in trace)"))
+
     record = {
         "batch": batch,
         "measured_ms_per_step": round(total / steps * 1e3, 2),
         "images_per_sec": round(imgs, 1),
         "floor_ms_per_step": round(floor_total, 2),
         "chip": {"matmul_tflops": MATMUL_TFLOPS / 1e12,
-                 "hbm_gbs": HBM_GBS / 1e9},
+                 "hbm_gbs": HBM_GBS / 1e9,
+                 "hbm_source": "CHIP_CEILING.json hbm_operative_gbs"},
+        "bytes_check": {
+            "model_gb_per_step": round(model_bytes / 1e9, 2),
+            "measured_gb_per_step": (round(measured_bytes / 1e9, 2)
+                                     if measured_bytes else None),
+            "measured_x_model": (round(measured_bytes / model_bytes, 3)
+                                 if measured_bytes else None)},
         "buckets": {
             b: {"ms": round(t / steps * 1e3, 2),
                 "floor_ms": (round(max(fl[b][0], fl[b][1]) * 1e3, 2)

@@ -51,6 +51,12 @@ def hlo_metadata(hlo_path):
 
 BUCKETS = [
     # (label, regex over "op_name || src")
+    # attention-adjacent relayouts FIRST: transposes/copies emitted from
+    # flash_attention.py are the [B,T,H,D] head-split copies around the
+    # streaming custom calls (~36 ms/step at seq-2048 pre-r6,
+    # NOTES_r5.md) — the packed streaming path exists to zero this bucket
+    ("attn-layout-copy",
+     r"(?=.*flash_attention)(?=.*(transpose|copy|reshape))"),
     ("attention-kernel", r"flash_attention|attn_fwd|attn_bwd"),
     ("vocab-head-ce", r"fused_linear_smooth_ce|softmax_with_cross_entropy|"
                       r"label_smooth|out_proj"),
@@ -108,6 +114,10 @@ def main():
     for b, t in sorted(cat.items(), key=lambda kv: -kv[1]):
         print("  %8.2f ms  %5.1f%%  %s"
               % (t / steps * 1e3, 100 * t / total, b))
+    copies_ms = cat.get("attn-layout-copy", 0.0) / steps * 1e3
+    print("attention layout copies: %.2f ms/step (0 = the packed "
+          "streaming path is copy-free; pre-r6 head-split measured "
+          "~36 ms at seq-2048)" % copies_ms)
     if args.detail:
         print("\n== top rows ==")
         top = sorted(rows.items(), key=lambda kv: -kv[1])[:40]

@@ -3,6 +3,12 @@ time breakdown (top HLO ops by self time, grouped by category).
 
 Usage: python tools/profile_bench.py --model transformer [--steps 10]
 Writes the raw trace under /tmp/jaxtrace-<model> and prints a table.
+
+``--bytes`` additionally prints profiler-MEASURED HBM bytes/step (the
+per-op "bytes accessed" xplane stats) next to the analytic bytes model's
+prediction (attribute_resnet's floor model for resnet50) — so every
+roofline claim is one flag away from being cross-checked against what the
+chip actually moved (``bench.py --attribute`` runs this automatically).
 """
 
 import argparse
@@ -17,7 +23,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def capture(model, steps, batch=None):
+def capture(model, steps, batch=None, seq=None):
     import jax
     import paddle_tpu as fluid
     from bench import _build
@@ -26,7 +32,7 @@ def capture(model, steps, batch=None):
     main_prog, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main_prog, startup):
         spec, dbatch, metric, unit, per_example, _seq = _build(
-            model, on_tpu)
+            model, on_tpu, seq_override=seq)
         opt = fluid.optimizer.Adam(learning_rate=1e-4)
         if os.environ.get("BENCH_AMP", "1") == "1":
             opt = fluid.amp.decorate(opt)
@@ -49,13 +55,10 @@ def capture(model, steps, batch=None):
                                 fetch_list=[spec.loss], return_numpy=False)
         np.asarray(loss_val)
         jax.profiler.stop_trace()
-    return trace_dir
+    return trace_dir, main_prog, batch
 
 
-def parse_xplane(trace_dir):
-    """Parse the newest xplane proto under ``trace_dir`` into
-    (plane_name, line_name, op_name, seconds) rows. Shared by
-    ``tools/attribute_transformer.py``."""
+def _load_xspace(trace_dir):
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     paths = sorted(glob.glob(os.path.join(
@@ -65,6 +68,14 @@ def parse_xplane(trace_dir):
     space = xplane_pb2.XSpace()
     with open(paths[-1], "rb") as f:
         space.ParseFromString(f.read())
+    return space
+
+
+def parse_xplane(trace_dir):
+    """Parse the newest xplane proto under ``trace_dir`` into
+    (plane_name, line_name, op_name, seconds) rows. Shared by
+    ``tools/attribute_transformer.py``."""
+    space = _load_xspace(trace_dir)
 
     rows = []
     for plane in space.planes:
@@ -78,6 +89,68 @@ def parse_xplane(trace_dir):
                 rows.append((plane.name, line.name, name,
                              ev.duration_ps / 1e12))
     return rows
+
+
+def parse_xplane_bytes(trace_dir):
+    """Per-op HBM bytes from the xplane per-event "bytes accessed" stats
+    (summed over occurrences) on the sync op line. Returns {} when the
+    platform/profiler version doesn't record them."""
+    try:
+        space = _load_xspace(trace_dir)
+    except SystemExit:
+        return {}
+    agg = defaultdict(int)
+    for plane in space.planes:
+        if "TPU" not in plane.name and "/device" not in plane.name.lower():
+            continue
+        emeta = plane.event_metadata
+        smeta = plane.stat_metadata
+        for line in plane.lines:
+            if line.name != "XLA Ops":
+                continue
+            for ev in line.events:
+                md = emeta.get(ev.metadata_id)
+                name = md.name if md else str(ev.metadata_id)
+                for st in ev.stats:
+                    sm = smeta.get(st.metadata_id)
+                    # EXACT name: ops also carry per-memory-space
+                    # breakdown stats ("bytes accessed0", ...) that would
+                    # double-count against the aggregate
+                    if sm is None or sm.name.lower() != "bytes accessed":
+                        continue
+                    agg[name.split(" =")[0].lstrip("%")] += (
+                        st.uint64_value or st.int64_value)
+    return dict(agg)
+
+
+def bytes_report(trace_dir, steps, model=None, program=None, batch=None):
+    """Measured HBM bytes/step vs the analytic bytes model (resnet50 has
+    one — attribute_resnet's floor model; other configs print measured
+    only). The cross-check every roofline claim should survive."""
+    per_op = parse_xplane_bytes(trace_dir)
+    total = sum(per_op.values())
+    print("\n== HBM bytes/step (xplane 'bytes accessed' stats) ==")
+    if not total:
+        print("  no bytes-accessed stats in this trace "
+              "(CPU run or profiler version without per-op memory stats)")
+        measured = None
+    else:
+        measured = total / steps
+        print("  measured : %8.2f GB/step over %d ops"
+              % (measured / 1e9, len(per_op)))
+    analytic = None
+    if model == "resnet50" and program is not None:
+        from attribute_resnet import floors as resnet_floors
+
+        _, _, analytic = resnet_floors(program, batch)
+        print("  analytic : %8.2f GB/step (RESNET_ROOFLINE bytes model)"
+              % (analytic / 1e9))
+        if measured:
+            print("  measured/model = %.2fx  (>1: traffic the model does "
+                  "not count — un-fused passes, spills; <1: fusions "
+                  "sharing passes the model charges separately)"
+                  % (measured / analytic))
+    return measured, analytic
 
 
 def analyze(trace_dir, steps, topk=40):
@@ -152,9 +225,20 @@ if __name__ == "__main__":
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=None)
     ap.add_argument("--analyze-only", default=None)
+    ap.add_argument("--seq", type=int, default=None,
+                    help="transformer seq_len override (so the traced "
+                         "workload matches e.g. the seq-2048 bench line)")
+    ap.add_argument("--bytes", action="store_true",
+                    help="print measured HBM bytes/step vs the analytic "
+                         "bytes model")
     args = ap.parse_args()
     if args.analyze_only:
         analyze(args.analyze_only, args.steps)
+        if args.bytes:
+            bytes_report(args.analyze_only, args.steps, args.model)
     else:
-        td = capture(args.model, args.steps, args.batch)
+        td, prog, batch = capture(args.model, args.steps, args.batch,
+                                  args.seq)
         analyze(td, args.steps)
+        if args.bytes:
+            bytes_report(td, args.steps, args.model, prog, batch)
