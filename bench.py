@@ -187,15 +187,47 @@ def _bench_static(model, on_tpu, seq_override=None):
     if model == "deepfm":
         # roofline basis: embedding-bound CTR is per-ROW-LATENCY-bound on
         # TPU, so the floor sums the MLP's MXU time with the measured
-        # per-row gather/scatter latencies (models/deepfm.py documents
-        # the constants; tools/bench_gather.py measures them — chip
-        # properties like the measured HBM stream rate)
+        # per-row gather/scatter latencies. The constants are SOURCED
+        # from ROW_OP_FLOORS.json (tools/bench_gather.py --write; the
+        # CHIP_CEILING.json pattern) via models/deepfm.py row_op_floors —
+        # tests/test_bench_contract.py pins the sourcing.
         floor_s = ((spec.flops_per_example or 0) / _peak_flops(dev)
                    + spec.extras["row_latency_s_per_example"])
         config["row_latency_s_per_example"] = \
             spec.extras["row_latency_s_per_example"]
+        config["row_floors"] = spec.extras["row_floors"]
         target = 0.45 / max(floor_s, 1e-30)   # 45% of roofline examples/s
         vsb = (examples_per_sec / per_example) / target
+        # ISSUE 13 self-description: which sharded-lookup formulation a
+        # mesh run of this config would trace (mp=8 reference point),
+        # which scatter kernel the sparse backward takes on this
+        # platform, and the analytic ICI bytes of both lookup
+        # formulations at the bench id count — the re-derivable honesty
+        # line for the O(n*D + n) vs O(mp*n*D) claim.
+        from paddle_tpu.core.op_registry import env_flag
+        from paddle_tpu.ops import scatter as scatter_mod
+        from paddle_tpu.parallel import sharded_embedding as semb
+
+        # the fused-table geometry comes from the spec (width is the
+        # padded pow2 — 32 at the bench embedding_size=16, NOT 16)
+        ft = spec.extras["fused_table"]
+        n_ids = batch * ft["num_fields"]
+        ref_mp = 8
+        config["emb_strategy"] = semb.choose_strategy(n_ids, ref_mp,
+                                                      ft["width"])
+        config["emb_comm_model"] = dict(
+            semb.comm_bytes_model(n_ids, ft["width"], ref_mp),
+            n_ids=n_ids, width=ft["width"], mp=ref_mp)
+        # the sparse backward densifies at the PARAM dtype (f32 master
+        # table) regardless of AMP — gate the kernel claim on that
+        if scatter_mod.use_pallas(ft["vocab"], ft["width"], n_ids,
+                                  "float32"):
+            config["scatter_kernel"] = (
+                "pallas_sorted_segment"
+                if env_flag("PADDLE_TPU_SCATTER_SORT") else
+                "pallas_rowbin")
+        else:
+            config["scatter_kernel"] = "xla_at_add"
     else:
         flops_per_step = (spec.flops_per_example or 0) * batch
         mfu = (flops_per_step * steps / dt) / _peak_flops(dev)

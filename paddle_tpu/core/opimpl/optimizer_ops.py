@@ -40,7 +40,16 @@ _merge_rows = merge_sparse_rows
 
 
 def _densify(g, rows, shape):
-    """Fallback for optimizers without a dedicated sparse kernel."""
+    """Densify a (rows, values) sparse grad for optimizers whose update
+    runs over every row (non-lazy adam — the DeepFM bench path — and the
+    optimizers without a dedicated sparse kernel). Routed through the
+    Pallas VMEM-resident scatter-add (``ops/scatter.py``) when the table
+    qualifies; XLA's ``.at[].add`` otherwise. Exact either way
+    (out-of-range sentinel rows drop, duplicates accumulate)."""
+    if len(shape) == 2 and g.ndim == 2:
+        from ...ops.scatter import scatter_add_rows
+
+        return scatter_add_rows(jnp.zeros(shape, g.dtype), rows, g)
     return jnp.zeros(shape, g.dtype).at[rows].add(g, mode="drop")
 
 
@@ -67,8 +76,15 @@ def _sgd(env, op):
     g, rows = _sparse_grad(env, op)
     if rows is not None:
         # ref sgd_op.h SelectedRows branch: scatter-add handles duplicates
-        put(env, op.output("ParamOut"),
-            p.at[rows].add(-_lr(env, op) * g, mode="drop"))
+        # (Pallas row-scatter when the table qualifies — ops/scatter.py)
+        upd = -_lr(env, op) * g
+        if p.ndim == 2 and upd.ndim == 2:
+            from ...ops.scatter import scatter_add_rows
+
+            put(env, op.output("ParamOut"), scatter_add_rows(p, rows, upd))
+        else:
+            put(env, op.output("ParamOut"),
+                p.at[rows].add(upd, mode="drop"))
         return
     put(env, op.output("ParamOut"), p - _lr(env, op) * g)
 

@@ -501,18 +501,42 @@ def _ids_shape(ids):
     return ids
 
 
-@register_shape("lookup_table")
+@register_shape("lookup_table", "sharded_lookup_table")
 def _lookup_table_shape(ctx, op):
+    # sharded_lookup_table is the transpiled (mesh-routed) form of
+    # lookup_table — identical shape contract, so transpiled programs
+    # verify as first-class citizens (ISSUE 13)
     ws = ctx.shape(op.input("W"))
     ids = _ids_shape(ctx.shape(op.input("Ids")))
     if ws is None or ids is None:
         ctx.set(op.output("Out"), None, ctx.dtype(op.input("W")))
         return
     if len(ws) != 2:
-        raise ShapeError("lookup_table W '%s' must be 2-D, got %s"
-                         % (op.input("W").name, list(ws)))
+        raise ShapeError("%s W '%s' must be 2-D, got %s"
+                         % (op.type, op.input("W").name, list(ws)))
     ctx.set(op.output("Out"), tuple(ids) + (ws[1],),
             ctx.dtype(op.input("W")))
+
+
+@register_shape("scatter")
+def _scatter_shape(ctx, op):
+    """Row scatter (set/add): Out has X's shape; Updates' trailing dims
+    must match X's (the sparse-grad accumulation path — optimizer.py
+    grad-acc and the ops/scatter.py kernel's symbolic form)."""
+    xs = ctx.shape(op.input("X"))
+    us = ctx.shape(op.input("Updates"))
+    dt = ctx.dtype(op.input("X"))
+    if xs is not None and us is not None and len(us) >= 1 \
+            and len(xs) >= 1:
+        xt, ut = tuple(xs[1:]), tuple(us[1:])
+        if len(xt) == len(ut) and any(
+                a != -1 and b != -1 and a != b for a, b in zip(xt, ut)):
+            raise ShapeError(
+                "scatter Updates '%s' trailing dims %s do not match X "
+                "'%s' trailing dims %s"
+                % (op.input("Updates").name, list(us), op.input("X").name,
+                   list(xs)))
+    ctx.set(op.output("Out"), xs, dt)
 
 
 @register_shape("one_hot")

@@ -47,10 +47,41 @@ class _PaddedTableInitializer(Initializer):
         block.append_op("elementwise_mul", {"X": var, "Y": mask},
                         {"Out": var}, {})
 
-# measured v5e row-op latencies (tools/bench_gather.py; chip properties
-# in the same sense as the measured 552 GB/s stream bandwidth)
+# Fallback row-op latencies: the round-5 v5e measurements
+# (tools/bench_gather.py). These are NOT the operative constants — the
+# roofline sources them live from ROW_OP_FLOORS.json (the
+# CHIP_CEILING.json pattern: ``tools/bench_gather.py --write`` commits a
+# re-measurement and every subsequent bench record picks it up; the
+# sourcing is pinned by tests/test_bench_contract.py). The 15 ns/row
+# scatter figure is the floor ISSUE 13's Pallas kernel (ops/scatter.py)
+# exists to challenge — a bench-chip --write run either drops it or
+# earns it its name (NOTES_r7.md).
 _GATHER_NS_PER_ROW = 2.0
 _SCATTER_NS_PER_ROW = 15.0
+
+
+def row_op_floors(path=None):
+    """(gather_ns, scatter_ns, source): the measured per-row latencies
+    from ``ROW_OP_FLOORS.json`` beside bench.py, falling back to the
+    round-5 constants above (source then says so)."""
+    import json
+    import os
+
+    if path is None:
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "ROW_OP_FLOORS.json")
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        if isinstance(rec, dict):
+            gather = rec.get("gather_ns_per_row")
+            scatter = rec.get("scatter_ns_per_row")
+            if gather and scatter:
+                return float(gather), float(scatter), "ROW_OP_FLOORS.json"
+    except (OSError, ValueError, TypeError):
+        pass
+    return _GATHER_NS_PER_ROW, _SCATTER_NS_PER_ROW, "builtin-r5"
 
 
 def deepfm(sparse_feature_dim=100000, num_fields=26, embedding_size=16,
@@ -113,7 +144,8 @@ def deepfm(sparse_feature_dim=100000, num_fields=26, embedding_size=16,
     dims = [num_fields * embedding_size + dense_dim] + list(hidden_sizes) \
         + [1]
     mlp_flops = 6 * sum(a * b for a, b in zip(dims[:-1], dims[1:]))
-    row_s = num_fields * (_GATHER_NS_PER_ROW + _SCATTER_NS_PER_ROW) * 1e-9
+    gather_ns, scatter_ns, floor_source = row_op_floors()
+    row_s = num_fields * (gather_ns + scatter_ns) * 1e-9
     return ModelSpec(
         loss,
         feeds={"feat_ids": FeedSpec([num_fields], "int64", 0,
@@ -122,4 +154,13 @@ def deepfm(sparse_feature_dim=100000, num_fields=26, embedding_size=16,
                "label": FeedSpec([1], "int64", 0, 2)},
         fetches={"prob": prob},
         flops_per_example=mlp_flops,
-        extras={"row_latency_s_per_example": row_s})
+        extras={"row_latency_s_per_example": row_s,
+                "row_floors": {"gather_ns_per_row": gather_ns,
+                               "scatter_ns_per_row": scatter_ns,
+                               "source": floor_source},
+                # the fused-table geometry consumers (bench.py's
+                # self-description) must not re-derive: width is the
+                # padded pow2, NOT embedding_size
+                "fused_table": {"vocab": sparse_feature_dim,
+                                "width": width,
+                                "num_fields": num_fields}})

@@ -1,0 +1,222 @@
+"""TPU-native row scatter-add for narrow embedding tables.
+
+The backward of every embedding-bound model is a row scatter-add
+(``grad_table.at[ids].add(grad_rows)``), and round 5 measured XLA's
+lowering at ~15 ns/row regardless of row width (``tools/bench_gather.py``
+``s_k16``/``s_k128``) — a per-row HBM read-modify-write DMA each, declared
+a "chip property" in ``models/deepfm.py``. This module is the purpose-built
+challenge to that claim (ROADMAP item 3): for tables whose PACKED layout
+fits VMEM, the scatter runs as a Pallas kernel that
+
+  1. streams the table HBM->VMEM once (as the kernel's aliased output
+     block) in the packed ``P = 128 // K`` rows-per-128-lane layout of
+     ``ops/rowops.py`` — so a [100000, 16] f32 table is a 6.4 MB VMEM
+     resident, not a 51 MB lane-padded one;
+  2. accumulates every (row, value) pair into the VMEM-resident table with
+     a lane-positioned masked add (row -> (row // P, lanes (row % P)*K..)),
+     so duplicate ids cost a VMEM add, never an HBM round trip; and
+  3. streams the table back VMEM->HBM once.
+
+Total HBM traffic: ``2*V*K + N*K`` bytes instead of N serialized row RMW
+DMAs — at the DeepFM bench shape (V=100k, K=16, N=212992) that is ~26 MB
+of streaming vs 212992 latency-bound DMAs, a ~50x headroom if the VMEM
+accumulate loop keeps up. The sorted-segment formulation the ISSUE names
+(sort ids, segment-reduce duplicates, one dense store per unique row) is
+kept as an A/B variant (``sort=True`` / ``PADDLE_TPU_SCATTER_SORT=1``):
+sorting buys store locality but costs an argsort (~7 ms/step at the bench
+shape — see ``control_ops`` merge note), so the default path is unsorted
+and duplicate-safe by serial accumulation. ``tools/bench_gather.py``
+measures both against ``.at[ids].add`` and ``--write`` commits the winner
+to ``ROW_OP_FLOORS.json`` (the ``CHIP_CEILING.json`` pattern); until a
+bench-chip run lands, the 15 ns/row floor stands and the pallas entries
+are null (committed-negative-result form, NOTES_r7.md).
+
+Reference capability: ``operators/math/selected_rows_functor.cc`` MergeAdd
++ the SelectedRows optimizer kernels — the reference's answer to sparse
+rows is pserver-side partial tables; ours is keeping the whole narrow
+table VMEM-resident for the duration of one scatter pass.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["scatter_add_rows", "use_pallas", "packed_vmem_bytes"]
+
+_LANES = 128
+_INTERPRET = False  # tests flip this to run the kernel on CPU
+
+# The packed table + one double-buffered vals block must fit comfortably;
+# leave headroom for the vals stream and compiler temporaries. 10 MB
+# admits the [100k, 16] f32 microbench table (6.4 MB packed) but NOT the
+# DeepFM bench's [100k, 32] f32 fused table (12.8 MB packed) —
+# PADDLE_TPU_SCATTER_VMEM_MB raises the budget toward the 16 MB/core
+# ceiling for the on-chip A/B (tools/bench_gather.py s_pallas_w32 runs
+# at 14; whether Mosaic fits it is part of the pending measurement).
+_DEFAULT_VMEM_MB = 10
+_CHUNK = 1024  # (rows, vals) slots processed per grid step
+
+
+def _vmem_budget():
+    import os
+
+    try:
+        mb = float(os.environ.get("PADDLE_TPU_SCATTER_VMEM_MB",
+                                  _DEFAULT_VMEM_MB))
+    except ValueError:
+        mb = _DEFAULT_VMEM_MB
+    return int(mb * 1024 * 1024)
+
+
+def packed_vmem_bytes(v, k, esize):
+    """VMEM bytes of the [Vp, P*K] packed table block (the kernel's
+    resident accumulator)."""
+    from .rowops import pack_factor
+
+    p = pack_factor(k)
+    vp = -(-v // p)
+    width = p * k
+    # lane dim pads to 128, sublane to the dtype tile height
+    width_pad = -(-width // _LANES) * _LANES
+    sub = {2: 16, 4: 8}.get(esize, 8)
+    vp_pad = -(-vp // sub) * sub
+    return vp_pad * width_pad * esize
+
+
+def use_pallas(v, k, n, dtype):
+    """Gate: the packed table fits the VMEM budget, the row width packs
+    (or is already lane-aligned), and we are on a single TPU (a mesh
+    would make the custom call fight GSPMD) or under the test
+    interpreter."""
+    from .rowops import pack_factor
+
+    if k <= 0 or v <= 0 or n <= 0:
+        return False
+    if pack_factor(k) == 1 and k % _LANES:
+        return False  # unpackable narrow width: lane padding explodes VMEM
+    dt = jnp.dtype(dtype)
+    if not jnp.issubdtype(dt, jnp.floating):
+        return False  # grad surfaces are float; int tables keep XLA
+    esize = dt.itemsize
+    if esize not in (2, 4):
+        return False
+    if packed_vmem_bytes(v, k, esize) + 2 * _CHUNK * max(k, _LANES) * esize \
+            > _vmem_budget():
+        return False
+    if _INTERPRET:
+        return True
+    from ..core.op_registry import env_flag, single_tpu
+
+    if env_flag("PADDLE_TPU_NO_PALLAS_SCATTER"):  # A/B escape hatch
+        return False
+    return single_tpu()
+
+
+def _scatter_kernel(rows_ref, vals_ref, tab_in_ref, out_ref, *, chunk, p, k,
+                    vp):
+    """One grid step: fold ``chunk`` (row, value) pairs into the
+    VMEM-resident packed table. ``rows_ref`` is scalar-prefetched so the
+    serial accumulate loop reads indices from SMEM; sentinel rows
+    (>= vp*p: out-of-range ids, padding slots, merged-duplicate parking)
+    skip the store. out_ref aliases the packed table input — pallas
+    streams it HBM->VMEM once, every add below is a VMEM op, and the
+    final writeback is the only other HBM pass."""
+    from jax.experimental import pallas as pl
+
+    del tab_in_ref  # aliased with out_ref; present only for the alias slot
+    base = pl.program_id(0) * chunk
+    lane_grp = jax.lax.broadcasted_iota(jnp.int32, (1, p * k), 1) // k
+
+    def body(i, carry):
+        r = rows_ref[base + i]
+
+        @pl.when(r < vp * p)
+        def _():
+            v = vals_ref[i, :].reshape(1, k)
+            v_tiled = jnp.concatenate([v] * p, axis=1) if p > 1 else v
+            pos = jnp.where(lane_grp == r % p, v_tiled,
+                            jnp.zeros_like(v_tiled))
+            out_ref[pl.ds(r // p, 1), :] += pos
+
+        return carry
+
+    jax.lax.fori_loop(0, chunk, body, 0)
+
+
+def _scatter_packed_call(bp, rows, vals, p, k, vp):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = rows.shape[0]
+    chunk = min(_CHUNK, n) if n % _CHUNK else _CHUNK
+    n_pad = -(-n // chunk) * chunk
+    if n_pad != n:
+        rows = jnp.concatenate(
+            [rows, jnp.full((n_pad - n,), vp * p, jnp.int32)])
+        vals = jnp.concatenate(
+            [vals, jnp.zeros((n_pad - n, k), vals.dtype)])
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_pad // chunk,),
+        in_specs=[pl.BlockSpec((chunk, k), lambda i, rr: (i, 0)),
+                  pl.BlockSpec((vp, p * k), lambda i, rr: (0, 0))],
+        out_specs=pl.BlockSpec((vp, p * k), lambda i, rr: (0, 0)),
+    )
+    return pl.pallas_call(
+        functools.partial(_scatter_kernel, chunk=chunk, p=p, k=k, vp=vp),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((vp, p * k), bp.dtype),
+        input_output_aliases={2: 0},
+        interpret=_INTERPRET,
+    )(rows, vals, bp)
+
+
+def _sorted_merge(rows, vals, sentinel):
+    """The ISSUE's sorted-segment formulation: sort ids, segment-reduce
+    duplicates, leaving one dense store per unique row (duplicate slots
+    parked on the dropped sentinel). A/B variant — the argsort costs more
+    than the serial-accumulate default saves at the bench shapes."""
+    from ..core.op_registry import merge_sparse_rows
+
+    return merge_sparse_rows(rows, vals, sentinel)
+
+
+def scatter_add_rows(base, rows, vals, sort=None):
+    """``base.at[rows].add(vals, mode="drop")`` for a 2-D ``[V, K]``
+    table — via the VMEM-resident Pallas kernel when :func:`use_pallas`
+    admits the shape, else the XLA scatter. Exact: out-of-range rows
+    drop, duplicate rows accumulate.
+
+    rows: [N] integer; vals: [N, K] (or broadcastable leading shape that
+    flattens to it). ``sort=True`` (or PADDLE_TPU_SCATTER_SORT=1) routes
+    through the sorted-segment merge first.
+    """
+    v, k = base.shape
+    rows = rows.reshape(-1).astype(jnp.int32)
+    vals = vals.reshape(-1, k)
+    if vals.dtype != base.dtype:
+        vals = vals.astype(base.dtype)
+    if not use_pallas(v, k, rows.shape[0], base.dtype):
+        return base.at[rows].add(vals, mode="drop")
+    from .rowops import pack_factor
+
+    p = pack_factor(k)
+    vp = -(-v // p)
+    if sort is None:
+        from ..core.op_registry import env_flag
+
+        sort = env_flag("PADDLE_TPU_SCATTER_SORT")
+    # exact ``.at[].add(mode="drop")`` index semantics: negative rows in
+    # [-V, 0) wrap python-style, anything else out of range parks on the
+    # sentinel (dropped by the kernel) — so the packed row/sub
+    # decomposition below always sees in-range or sentinel rows
+    rows = jnp.where((rows >= -v) & (rows < 0), rows + v, rows)
+    rows = jnp.where((rows >= 0) & (rows < v), rows, vp * p)
+    if sort:
+        rows, vals = _sorted_merge(rows, vals, vp * p)
+    pad = vp * p - v
+    bp = jnp.pad(base, ((0, pad), (0, 0))) if pad else base
+    bp = bp.reshape(vp, p * k)
+    out = _scatter_packed_call(bp, rows, vals, p, k, vp)
+    return out.reshape(vp * p, k)[:v]

@@ -1,26 +1,104 @@
 """Sharded embedding lookup — the pserver / distributed-lookup-table analog.
 
 Reference: params sliced across pservers (``distribute_transpiler.py:84``
-slice_variable), trainers pull rows via RPC prefetch
-(``operators/distributed/parameter_prefetch.cc``). TPU-native: the table is
-row-sharded over a mesh axis; the lookup runs under shard_map — each shard
-gathers its local rows and a psum merges partial rows (one ICI collective,
-no RPC plane).
+slice_variable), trainers pull ONLY the rows they need via prefetch RPC
+(``operators/distributed/parameter_prefetch.cc:26`` splits ids by section,
+sends each pserver its id packet, receives the matching rows). TPU-native:
+the table is row-sharded over a mesh axis and the lookup runs under
+shard_map with two formulations:
+
+* **id-routed all-to-all** (default — the faithful prefetch analog): each
+  shard takes a 1/mp slice of the replicated id list, bins its ids by
+  owning shard (sort-by-owner + within-owner rank -> a [mp, cap] slot
+  buffer), ``all_to_all``s the id packets, gathers ONLY the rows it owns
+  through the ``packed_take`` fast path, ``all_to_all``s the [cap, D] row
+  payloads back, unpermutes, and ``all_gather``s the per-shard slices into
+  the replicated output the surrounding program expects. Per-shard ICI
+  volume: ``n*D`` row payload + ``n`` ids + the ``(mp-1)/mp * n*D``
+  output replication — O(n*D + n), independent of mp. Per-destination
+  capacity is the skew-proof ``cap = ceil(n/mp)`` (a shard holds at most
+  its whole slice of ids), so ANY id distribution — including every id
+  hashing to one shard — is exact; skew costs load imbalance only in the
+  valid-slot counts, never correctness. (A sub-``cap`` MoE-style capacity
+  factor would cut the padded-slot traffic by ~mp in the balanced case,
+  but without ragged collectives overflowed rows would silently drop;
+  this framework does not trade correctness for bytes — see NOTES_r7.md
+  for the full accounting.)
+* **psum-of-partials** (``PADDLE_TPU_EMB_PSUM=1`` A/B fallback, and the
+  auto-selected path for degenerate slices): every shard gathers ALL n
+  ids against its local slice (zeros for rows it doesn't own) and one
+  psum merges the [n, D] partials — mp redundant full-output gathers and
+  O(mp * n * D) total reduced volume, which is what capped mp=8+ scaling
+  (ROADMAP item 3).
+
+``choose_strategy`` picks per call: psum only when forced by env or when
+the per-shard slice is too small for the sort/route overhead to amortize
+(``cap < PADDLE_TPU_EMB_MIN_CHUNK``, default 8 — the capacity-factor
+heuristic's degenerate regime). ``comm_bytes_model`` is the analytic
+bytes line the bench record carries (ISSUE 13 acceptance).
 """
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..core.op_registry import register, get, put
+from ..core.op_registry import register, get, put, env_flag
 
-__all__ = ["sharded_lookup"]
+__all__ = ["sharded_lookup", "choose_strategy", "comm_bytes_model"]
+
+_MIN_CHUNK_DEFAULT = 8
 
 
-def sharded_lookup(table, ids, mesh, axis="mp"):
-    """table: [V, D] sharded (axis, None); ids: [...] int32 global ids.
-    Returns [..., D] rows. psum-of-partials formulation: each shard
-    contributes rows it owns, zeros elsewhere — one reduce over the axis."""
+def _min_chunk():
+    import os
+
+    try:
+        return int(os.environ.get("PADDLE_TPU_EMB_MIN_CHUNK",
+                                  _MIN_CHUNK_DEFAULT))
+    except ValueError:
+        return _MIN_CHUNK_DEFAULT
+
+
+def choose_strategy(n_ids, n_shards, width=None):
+    """'alltoall' | 'psum' for a lookup of ``n_ids`` over ``n_shards``.
+
+    PADDLE_TPU_EMB_PSUM=1 forces the legacy psum A/B path. Otherwise the
+    routed path wins whenever each shard's id slice (= the skew-proof
+    per-destination capacity) is big enough to amortize the on-device
+    binning sort and the collective hops; tiny slices (the degenerate
+    capacity regime) keep the single fused psum."""
+    del width  # volume ratio is width-independent; kept for future tuning
+    if env_flag("PADDLE_TPU_EMB_PSUM"):
+        return "psum"
+    cap = -(-int(n_ids) // max(int(n_shards), 1))
+    if cap < _min_chunk():
+        return "psum"
+    return "alltoall"
+
+
+def comm_bytes_model(n_ids, width, n_shards, esize=4):
+    """Analytic per-step ICI bytes of both formulations (the bench
+    record's honesty line — re-derivable, not measured).
+
+    psum: every shard contributes a FULL [n, D] partial; the reduction
+    combines mp of them (total reduced volume mp*n*D*e; per-link on a
+    bidirectional ring all-reduce ~2*(mp-1)/mp*n*D*e).
+    alltoall: n ids out + n*D payload back + (mp-1)/mp*n*D output
+    replication — per-shard O(n*D + n), mp-independent."""
+    n, d, m = int(n_ids), int(width), int(n_shards)
+    nd = n * d * esize
+    return {
+        "psum_total_bytes": m * nd,
+        "psum_per_link_bytes": int(2 * (m - 1) / max(m, 1) * nd),
+        "alltoall_total_bytes": n * 4 + nd + int((m - 1) / max(m, 1) * nd),
+        "alltoall_per_link_bytes": int(
+            (m - 1) / max(m, 1) * (n * 4 + 2 * nd)),
+    }
+
+
+def _psum_lookup(table, ids, mesh, axis):
+    """Legacy formulation: each shard contributes the rows it owns, zeros
+    elsewhere — one reduce over the axis, O(mp * n * D) total volume."""
     from jax.experimental.shard_map import shard_map
 
     n_shards = mesh.shape[axis]
@@ -48,6 +126,83 @@ def sharded_lookup(table, ids, mesh, axis="mp"):
     )(table, ids)
 
 
+def _alltoall_lookup(table, ids, mesh, axis):
+    """Id-routed formulation (see module docstring). ``ids`` arrives
+    replicated (P()); each shard serves the slice it is responsible for
+    and the output is re-replicated by one tiled all_gather."""
+    from jax.experimental.shard_map import shard_map
+
+    m = mesh.shape[axis]
+    v, d = table.shape
+    rows_per = v // m
+
+    def routed(tab, ids_):
+        from ..ops.rowops import packed_take
+
+        n = ids_.shape[0]
+        cap = -(-n // m)           # skew-proof per-destination capacity
+        n_pad = cap * m
+        if n_pad != n:
+            # pad with an invalid id: routed to shard 0, masked to a zero
+            # row there, sliced off after the gather
+            ids_ = jnp.concatenate(
+                [ids_, jnp.full((n_pad - n,), -1, jnp.int32)])
+        my = jax.lax.axis_index(axis)
+        mine = jax.lax.dynamic_slice(ids_, (my * cap,), (cap,))
+        # bin by owning shard: out-of-range ids keep the psum path's
+        # contract (zero rows) — clip the owner so they route SOMEWHERE
+        # and fail the owner-side range mask there
+        owner = jnp.clip(mine // max(rows_per, 1), 0, m - 1)
+        order = jnp.argsort(owner)
+        ids_sorted = mine[order]
+        owner_sorted = owner[order]
+        first = jnp.searchsorted(owner_sorted, owner_sorted, side="left")
+        rank = jnp.arange(cap, dtype=jnp.int32) - first.astype(jnp.int32)
+        slot = owner_sorted * cap + rank      # rank < cap by construction
+        send_ids = jnp.full((m * cap,), -1, jnp.int32).at[slot].set(
+            ids_sorted)
+        # route the id packets: recv[s] = the bucket shard s addressed to me
+        recv_ids = jax.lax.all_to_all(
+            send_ids.reshape(m, cap), axis, 0, 0).reshape(m * cap)
+        lo = my * rows_per
+        local = recv_ids - lo
+        valid = (local >= 0) & (local < rows_per)
+        safe = jnp.clip(local, 0, rows_per - 1)
+        # each shard gathers ONLY rows it owns — the packed fast path
+        rows = packed_take(tab, safe)
+        rows = rows * valid[:, None].astype(rows.dtype)
+        # route the row payloads back and unpermute
+        back = jax.lax.all_to_all(
+            rows.reshape(m, cap, d), axis, 0, 0).reshape(m * cap, d)
+        got = back[slot][jnp.argsort(order)]         # [cap, D], my slice
+        out = jax.lax.all_gather(got, axis, axis=0, tiled=True)
+        return out[:n]
+
+    return shard_map(
+        routed, mesh=mesh,
+        in_specs=(P(axis, None), P()),
+        out_specs=P(),
+        check_rep=False,
+    )(table, ids)
+
+
+def sharded_lookup(table, ids, mesh, axis="mp", strategy=None):
+    """table: [V, D] sharded (axis, None); ids: [...] int32 global ids.
+    Returns [..., D] rows (replicated over ``axis``). ``strategy``:
+    'alltoall' | 'psum' | None (auto via :func:`choose_strategy`)."""
+    idf = ids.reshape(-1).astype(jnp.int32)
+    n = idf.shape[0]
+    if strategy in (None, "auto"):
+        strategy = choose_strategy(n, mesh.shape[axis], table.shape[1])
+    if strategy == "psum":
+        out = _psum_lookup(table, idf, mesh, axis)
+    elif strategy == "alltoall":
+        out = _alltoall_lookup(table, idf, mesh, axis)
+    else:
+        raise ValueError("unknown sharded_lookup strategy %r" % (strategy,))
+    return out.reshape(tuple(ids.shape) + (table.shape[1],))
+
+
 @register("sharded_lookup_table")
 def _sharded_lookup_op(env, op):
     """Symbolic op form used when a program is transpiled with
@@ -63,7 +218,8 @@ def _sharded_lookup_op(env, op):
     mesh = get_mesh()
     axis = op.attr("mesh_axis", "mp")
     if mesh is not None and axis in mesh.axis_names and mesh.shape[axis] > 1:
-        out = sharded_lookup(w, ids, mesh, axis)
+        out = sharded_lookup(w, ids, mesh, axis,
+                             strategy=op.attr("emb_strategy", None))
     else:
         from ..ops.rowops import packed_take
 

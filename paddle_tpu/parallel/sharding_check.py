@@ -14,7 +14,8 @@ a ``sharding={...}`` annotation, and ``metadata={op_name="state['<var>']"}``
 import re
 
 __all__ = ["assert_no_param_allgather", "assert_param_sharded",
-           "entry_param_shardings", "collect_allgather_shapes"]
+           "entry_param_shardings", "collect_allgather_shapes",
+           "collect_jaxpr_collectives", "assert_no_full_output_psum"]
 
 _SHAPE_RE = re.compile(r"=\s*\(?[a-z0-9]+\[([0-9,]*)\]")
 
@@ -73,6 +74,75 @@ def collect_allgather_shapes(hlo_text):
         if tup and tup[-1]:
             shapes.append(tuple(int(d) for d in tup[-1].split(",")))
     return shapes
+
+
+_COLLECTIVE_PRIMS = ("psum", "all_to_all", "all_gather", "psum_scatter",
+                     "ppermute", "all_gather_invariant")
+# shard_map's check_rep machinery rewrites psum to its rep-tracking
+# variant "psum2" in the jaxpr — report it under the canonical name
+_PRIM_ALIASES = {"psum2": "psum"}
+
+
+def collect_jaxpr_collectives(jaxpr):
+    """[(primitive_name, axes, [out shapes...])] for every named-axis
+    collective anywhere in a (Closed)Jaxpr, recursing into sub-jaxprs
+    (shard_map bodies, cond branches, scan/while bodies, pjit calls).
+
+    The jaxpr view is the right layer for the ISSUE 13 psum audit: a
+    psum primitive can ONLY enter the program through an explicit
+    ``jax.lax.psum`` inside a shard_map body (GSPMD's implicit
+    collectives appear later, in the HLO), so a [n, D] psum here IS the
+    psum-of-partials lookup formulation, with no replica-group parsing
+    or shape-coincidence heuristics."""
+    closed = getattr(jaxpr, "jaxpr", jaxpr)
+    found = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            name = _PRIM_ALIASES.get(eqn.primitive.name,
+                                     eqn.primitive.name)
+            if name in _COLLECTIVE_PRIMS:
+                axes = eqn.params.get("axes",
+                                      eqn.params.get("axis_name"))
+                shapes = [tuple(getattr(v.aval, "shape", ()))
+                          for v in eqn.outvars]
+                found.append((name, axes, shapes))
+            for sub in _subjaxprs(eqn.params):
+                walk(sub)
+
+    def _subjaxprs(params):
+        for v in params.values():
+            for sub in _as_jaxprs(v):
+                yield sub
+
+    def _as_jaxprs(v):
+        if hasattr(v, "eqns"):                      # Jaxpr
+            yield v
+        elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+            yield v.jaxpr                           # ClosedJaxpr
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                yield from _as_jaxprs(item)
+
+    walk(closed)
+    return found
+
+
+def assert_no_full_output_psum(collectives, width):
+    """ISSUE 13 dryrun stage: the id-routed sharded-embedding step must
+    not reduce a full lookup output. In the jaxpr (see
+    :func:`collect_jaxpr_collectives`) the psum-of-partials formulation
+    is a ``psum`` of a >=2-D tensor with last dim = ``width`` (the table
+    row width); the routed path has none — its collectives are
+    ``all_to_all`` (+ the output-replication ``all_gather``)."""
+    bad = [(name, axes, s)
+           for name, axes, shapes in collectives if name == "psum"
+           for s in shapes if len(s) >= 2 and s[-1] == width]
+    assert not bad, (
+        "sharded-embedding step psums full [n, %d] lookup outputs %s — "
+        "the psum-of-partials formulation leaked onto the all-to-all "
+        "path (O(mp*n*D) redundant ICI volume; "
+        "parallel/sharded_embedding.py)" % (width, bad))
 
 
 def assert_no_param_allgather(hlo_text, param_shapes):

@@ -166,3 +166,81 @@ def test_batch_rounding_warns(monkeypatch):
     msgs = [str(w.message) for w in caught
             if issubclass(w.category, RuntimeWarning)]
     assert any("ROUNDED DOWN" in m for m in msgs), msgs
+
+
+def test_row_floor_constants_are_sourced(tmp_path):
+    """ISSUE 13 floor pin: DeepFM's roofline constants come from
+    ROW_OP_FLOORS.json (the CHIP_CEILING.json pattern), live — a
+    re-measured file changes the spec, a missing one falls back to the
+    round-5 builtins with the source saying so."""
+    import json
+
+    from paddle_tpu.models import deepfm as deepfm_mod
+
+    # the committed record drives the default (and carries the pending
+    # pallas A/B slots — the committed-negative-result form)
+    g, s, src = deepfm_mod.row_op_floors()
+    assert src == "ROW_OP_FLOORS.json"
+    import os
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(deepfm_mod.__file__))))
+    with open(os.path.join(repo_root, "ROW_OP_FLOORS.json")) as f:
+        rec = json.load(f)
+    assert (g, s) == (rec["gather_ns_per_row"], rec["scatter_ns_per_row"])
+    assert "s_pallas" in rec["matrix_ns_per_row"]
+    # live sourcing, not a copied literal
+    alt = tmp_path / "ROW_OP_FLOORS.json"
+    alt.write_text(json.dumps({"gather_ns_per_row": 1.5,
+                               "scatter_ns_per_row": 4.0}))
+    assert deepfm_mod.row_op_floors(str(alt)) == (1.5, 4.0,
+                                                  "ROW_OP_FLOORS.json")
+    # fallback: missing/corrupt file -> builtin constants, source honest
+    g2, s2, src2 = deepfm_mod.row_op_floors(str(tmp_path / "missing.json"))
+    assert (g2, s2) == (deepfm_mod._GATHER_NS_PER_ROW,
+                        deepfm_mod._SCATTER_NS_PER_ROW)
+    assert src2 == "builtin-r5"
+
+
+def test_deepfm_spec_extras_carry_floor_provenance(monkeypatch):
+    specs = _specs(monkeypatch)
+    extras = specs["deepfm"][0].extras
+    rf = extras["row_floors"]
+    assert rf["source"] in ("ROW_OP_FLOORS.json", "builtin-r5")
+    expected = 26 * (rf["gather_ns_per_row"]
+                     + rf["scatter_ns_per_row"]) * 1e-9
+    assert abs(extras["row_latency_s_per_example"] - expected) < 1e-12
+
+
+def test_deepfm_record_is_self_describing(monkeypatch):
+    """The deepfm bench JSON line carries the ISSUE 13 fields: lookup
+    strategy (alltoall/psum), the analytic comm-bytes model for both
+    formulations, the scatter-kernel choice, and the sourced floor
+    constants."""
+    import bench
+
+    monkeypatch.setenv("BENCH_STEPS", "1")
+    monkeypatch.delenv("PADDLE_TPU_EMB_PSUM", raising=False)
+    monkeypatch.delenv("PADDLE_TPU_SCATTER_SORT", raising=False)
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        fluid.unique_name.switch()
+        rec = bench._bench_static("deepfm", on_tpu=False)
+    cfg = rec["config"]
+    assert cfg["emb_strategy"] == "alltoall"  # bench id count >> mp
+    cm = cfg["emb_comm_model"]
+    assert cm["mp"] == 8 and cm["n_ids"] == cfg["batch"] * 26
+    # the headline claim in numbers: psum total volume is O(mp) worse
+    assert cm["psum_total_bytes"] > 3 * cm["alltoall_total_bytes"]
+    assert cfg["scatter_kernel"] in ("pallas_rowbin",
+                                     "pallas_sorted_segment",
+                                     "xla_at_add")
+    assert cfg["row_floors"]["source"] in ("ROW_OP_FLOORS.json",
+                                           "builtin-r5")
+    # the A/B env reshapes the recorded strategy (sourcing is live)
+    monkeypatch.setenv("PADDLE_TPU_EMB_PSUM", "1")
+    main2, startup2 = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main2, startup2):
+        fluid.unique_name.switch()
+        rec2 = bench._bench_static("deepfm", on_tpu=False)
+    assert rec2["config"]["emb_strategy"] == "psum"

@@ -1,0 +1,178 @@
+"""ops/scatter.py — the Pallas VMEM-resident row scatter-add (ISSUE 13):
+exact ``.at[rows].add(vals, mode="drop")`` parity (duplicates, sentinel
+and negative rows, padding tails, f32+bf16, sorted-segment A/B),
+differentiability through ``packed_take``'s custom vjp, and the gate's
+refusals. Kernels run through the Pallas interpreter on CPU (the
+fused_conv/test pattern)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from paddle_tpu.ops import scatter
+from paddle_tpu.ops.rowops import packed_take
+
+
+@pytest.fixture(autouse=True)
+def _interpret(monkeypatch):
+    monkeypatch.setattr(scatter, "_INTERPRET", True)
+
+
+def _ref(base, rows, vals):
+    return base.at[rows.reshape(-1)].add(
+        vals.reshape(-1, base.shape[1]).astype(base.dtype), mode="drop")
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+@pytest.mark.parametrize("sort", [False, True])
+@pytest.mark.parametrize("v,k,n", [(100, 16, 333), (50, 8, 64),
+                                   (33, 32, 7), (257, 4, 1025),
+                                   (120, 128, 40)])
+def test_scatter_matches_at_add(dtype, sort, v, k, n, rng):
+    base = jnp.asarray(rng.randn(v, k)).astype(dtype)
+    rows = jnp.asarray(rng.randint(0, v, size=(n,)).astype("i4"))
+    vals = jnp.asarray(rng.randn(n, k)).astype(dtype)
+    assert scatter.use_pallas(v, k, n, dtype)
+    out = scatter.scatter_add_rows(base, rows, vals, sort=sort)
+    ref = _ref(base, rows, vals)
+    tol = 1e-6 if dtype == "float32" else 0.11  # bf16: summation order
+    np.testing.assert_allclose(np.asarray(out, dtype="f4"),
+                               np.asarray(ref, dtype="f4"),
+                               rtol=tol, atol=tol)
+
+
+def test_scatter_drop_and_wrap_semantics(rng):
+    """Out-of-range rows drop, negative rows wrap python-style — the
+    exact ``.at[].add(mode='drop')`` index contract (sentinel parking
+    from merge_sparse_rows relies on the drop)."""
+    v, k = 40, 16
+    base = jnp.asarray(rng.randn(v, k).astype("f4"))
+    rows = jnp.asarray(rng.randint(-2 * v, 2 * v, size=(200,))
+                       .astype("i4"))
+    vals = jnp.asarray(rng.randn(200, k).astype("f4"))
+    out = scatter.scatter_add_rows(base, rows, vals)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(
+        _ref(base, rows, vals)), rtol=1e-6, atol=1e-6)
+
+
+def test_scatter_heavy_duplicates(rng):
+    """Pathological skew: every update targets one row (the serial VMEM
+    accumulate and the sorted-segment merge must both sum exactly)."""
+    v, k, n = 64, 16, 500
+    base = jnp.zeros((v, k), jnp.float32)
+    rows = jnp.full((n,), 7, jnp.int32)
+    vals = jnp.asarray(rng.randn(n, k).astype("f4"))
+    for sort in (False, True):
+        out = scatter.scatter_add_rows(base, rows, vals, sort=sort)
+        expect = np.zeros((v, k), "f4")
+        expect[7] = np.asarray(vals).sum(axis=0)
+        np.testing.assert_allclose(np.asarray(out), expect, rtol=1e-4,
+                                   atol=1e-4)
+
+
+def test_scatter_multi_dim_vals(rng):
+    """[B, F] rows with [B, F, K] vals flatten like the sparse-grad
+    sites produce them."""
+    v, k = 30, 8
+    base = jnp.zeros((v, k), jnp.float32)
+    rows = jnp.asarray(rng.randint(0, v, size=(6, 4)).astype("i4"))
+    vals = jnp.asarray(rng.randn(6, 4, k).astype("f4"))
+    out = scatter.scatter_add_rows(base, rows, vals)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(
+        _ref(base, rows, vals)), rtol=1e-6, atol=1e-6)
+
+
+def test_gate_refusals():
+    # unpackable narrow width (lane padding would explode VMEM)
+    assert not scatter.use_pallas(1000, 100, 64, "float32")
+    # table too big for the VMEM budget
+    assert not scatter.use_pallas(4_000_000, 16, 64, "float32")
+    # int tables aren't a scatter-grad surface
+    assert not scatter.use_pallas(100, 16, 64, "int32")
+    # lane-aligned wide rows are fine
+    assert scatter.use_pallas(10_000, 128, 64, "float32")
+
+
+def test_gate_fallback_is_exact(rng, monkeypatch):
+    """Shapes the gate refuses still go through ``.at[].add`` — same
+    numbers, no kernel."""
+    monkeypatch.setattr(scatter, "_INTERPRET", False)
+    v, k, n = 100, 16, 64
+    assert not scatter.use_pallas(v, k, n, "float32")  # CPU: no TPU
+    base = jnp.asarray(rng.randn(v, k).astype("f4"))
+    rows = jnp.asarray(rng.randint(0, v, size=(n,)).astype("i4"))
+    vals = jnp.asarray(rng.randn(n, k).astype("f4"))
+    out = scatter.scatter_add_rows(base, rows, vals)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(
+        _ref(base, rows, vals)), rtol=1e-6, atol=1e-6)
+
+
+def test_scatter_under_jit(rng):
+    v, k, n = 64, 16, 100
+    base = jnp.asarray(rng.randn(v, k).astype("f4"))
+    rows = jnp.asarray(rng.randint(0, v, size=(n,)).astype("i4"))
+    vals = jnp.asarray(rng.randn(n, k).astype("f4"))
+    out = jax.jit(scatter.scatter_add_rows)(base, rows, vals)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(
+        _ref(base, rows, vals)), rtol=1e-6, atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# packed_take custom vjp: the sharded lookup's (and lookup_table's) grad
+# is the row scatter — same numbers as jax's native vjp of the gather
+# ---------------------------------------------------------------------------
+
+def test_packed_take_vjp_matches_native(rng):
+    v, k = 50, 16
+    w = jnp.asarray(rng.randn(v, k).astype("f4"))
+    ids = jnp.asarray(rng.randint(0, v, size=(7, 3)).astype("i4"))
+    cot = jnp.asarray(rng.randn(7, 3, k).astype("f4"))
+
+    def via_packed(w):
+        return jnp.sum(packed_take(w, ids) * cot)
+
+    def via_take(w):
+        return jnp.sum(jnp.take(w, ids, axis=0) * cot)
+
+    np.testing.assert_allclose(np.asarray(jax.grad(via_packed)(w)),
+                               np.asarray(jax.grad(via_take)(w)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_packed_take_vjp_duplicate_ids(rng):
+    v, k = 20, 8
+    w = jnp.asarray(rng.randn(v, k).astype("f4"))
+    ids = jnp.asarray(np.array([3, 3, 3, 19, 0, 3], dtype="i4"))
+    g = jax.grad(lambda w: jnp.sum(packed_take(w, ids) ** 2))(w)
+    g_ref = jax.grad(lambda w: jnp.sum(w[ids] ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_packed_take_value_and_jit_unchanged(rng):
+    """The custom_vjp wrapper must not perturb forward values (jit and
+    eager)."""
+    v, k = 37, 16
+    w = jnp.asarray(rng.randn(v, k).astype("f4"))
+    ids = jnp.asarray(rng.randint(0, v, size=(11,)).astype("i4"))
+    out = packed_take(w, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(w)[
+        np.asarray(ids)], rtol=1e-6)
+    out_jit = jax.jit(packed_take)(w, ids)
+    np.testing.assert_allclose(np.asarray(out_jit), np.asarray(out))
+
+
+def test_packed_take_vjp_bf16(rng):
+    """bf16 tables: the custom-vjp scatter grad matches the native-vjp
+    numbers (same dtype chain, summation-order tolerance only)."""
+    v, k = 40, 16
+    w = jnp.asarray(rng.randn(v, k)).astype(jnp.bfloat16)
+    ids = jnp.asarray(rng.randint(0, v, size=(25,)).astype("i4"))
+    g = jax.grad(lambda w: jnp.sum(
+        packed_take(w, ids).astype(jnp.float32) ** 2))(w)
+    g_ref = jax.grad(lambda w: jnp.sum(
+        jnp.take(w, ids, axis=0).astype(jnp.float32) ** 2))(w)
+    np.testing.assert_allclose(np.asarray(g, dtype="f4"),
+                               np.asarray(g_ref, dtype="f4"),
+                               rtol=0.05, atol=0.05)
