@@ -25,6 +25,18 @@ import sys
 from .passes import analyze_program, analyze_hlo_sharding
 
 
+def _lm_step_spec():
+    """Inference-only zoo entry: ModelSpec with loss=None, fetches = the
+    step program's logits + updated caches."""
+    from .. import models
+    from ..models.common import ModelSpec
+
+    fetch_vars, _spec = models.transformer.transformer_lm_step(
+        vocab=64, d_model=32, d_ff=64, n_head=2, n_layer=2, ctx_cap=16)
+    return ModelSpec(None, feeds={},
+                     fetches={v.name: v for v in fetch_vars})
+
+
 def _zoo_builders():
     """name -> zero-arg builder, CPU-sized configs (mirrors tests/
     test_models.py). Each builds into the CURRENT default program."""
@@ -45,6 +57,13 @@ def _zoo_builders():
         "transformer": lambda: models.transformer.transformer_base(
             src_vocab=64, trg_vocab=64, seq_len=16, d_model=32, d_ff=64,
             n_head=2, n_layer=2, dropout_rate=0.1),
+        "transformer.lm": lambda: models.transformer.transformer_lm(
+            vocab=64, seq_len=16, d_model=32, d_ff=64, n_head=2,
+            n_layer=2),
+        # the serving tier's KV-cache step program (no loss: inference
+        # only — the ISSUE 14 acceptance gate "decode programs verify
+        # clean"); fetches are the logits + carried caches
+        "transformer.lm_step": _lm_step_spec,
         "bert": lambda: models.bert.bert_base(
             vocab_size=64, seq_len=16, d_model=32, d_ff=64, n_head=2,
             n_layer=2, dropout_rate=0.1),
@@ -79,9 +98,11 @@ def analyze_zoo_model(builder, train=True):
     main, startup = fluid.Program(), fluid.Program()
     with fluid.program_guard(main, startup):
         spec = builder()
+        train = train and spec.loss is not None  # inference-only entries
         if train:
             fluid.optimizer.SGD(learning_rate=0.01).minimize(spec.loss)
-    fetches = [spec.loss.name] + [v.name for v in spec.fetches.values()]
+    fetches = ([spec.loss.name] if spec.loss is not None else []) \
+        + [v.name for v in spec.fetches.values()]
     return (analyze_program(main, fetch_names=fetches, donate_state=train),
             analyze_program(startup))
 

@@ -13,12 +13,19 @@ op records the forward op list at minimize time, so fake-quant ops
 inserted afterwards would be invisible to the backward.
 """
 
+import json
+import os
+
 import numpy as np
 
 from ...core import framework
 from ...core.framework import Operator
 
-__all__ = ["QuantizeTranspiler"]
+__all__ = ["QuantizeTranspiler", "export_int8_params", "load_int8_params",
+           "INT8_PARAMS_FILE", "INT8_MANIFEST_FILE"]
+
+INT8_PARAMS_FILE = "params.int8.npz"
+INT8_MANIFEST_FILE = "int8_manifest.json"
 
 _QUANTIZABLE = {
     "mul": ("X", "Y"),
@@ -172,3 +179,71 @@ class QuantizeTranspiler:
         s = np.maximum(scale, 1e-8)
         qw = np.round(np.clip(w / s, -1, 1) * qmax) / qmax * s
         return qw.astype(w.dtype), scale
+
+    def export_int8(self, dirname, scope=None):
+        """Write the deployable int8 export next to a
+        ``save_inference_model`` directory: ``params.int8.npz`` holding
+        each quantized weight as int8 + its per-channel scales, and an
+        ``int8_manifest.json`` with the quant axes/bits. ``Predictor``
+        (and therefore ``ServingEngine``) auto-detects the pair and
+        serves from it (``AnalysisConfig.enable_int8``). Call AFTER
+        ``freeze_program`` so the export round-trips losslessly onto the
+        frozen quantization grid."""
+        weights = self.convert_to_int8(None, scope=scope)
+        return export_int8_params(
+            dirname, weights,
+            axes={n: a for n, a in self._weight_quants.items()},
+            weight_bits=self.weight_bits)
+
+
+def export_int8_params(dirname, weights, axes, weight_bits=8):
+    """Serialize ``convert_to_int8``'s ``{name: (int8, scales)}`` dict.
+    4x smaller on disk than the fp32 params; the load path
+    (:func:`load_int8_params`) dequantizes back onto the exact
+    quantization grid the frozen program computed with."""
+    os.makedirs(dirname, exist_ok=True)
+    arrays = {}
+    for name, (i8, scale) in weights.items():
+        arrays[name] = np.asarray(i8, dtype=np.int8)
+        arrays[name + "@scale"] = np.asarray(scale,
+                                             dtype=np.float32).reshape(-1)
+    path = os.path.join(dirname, INT8_PARAMS_FILE)
+    np.savez(path, **arrays)
+    with open(os.path.join(dirname, INT8_MANIFEST_FILE), "w") as f:
+        json.dump({"weight_bits": int(weight_bits),
+                   "weights": {n: int(a) for n, a in axes.items()}},
+                  f, indent=1)
+    return path
+
+
+def load_int8_params(dirname, scope, require=False):
+    """Load the int8 export into ``scope``, dequantizing each weight as
+    ``(int8 / qmax) * scale`` — the exact expression ``freeze_program``
+    baked, so int8-served outputs match the frozen fp32 model bit-for-bit
+    up to float rounding. Returns the weight names loaded ([] when the
+    directory carries no export; raises when ``require``)."""
+    path = os.path.join(dirname, INT8_PARAMS_FILE)
+    man_path = os.path.join(dirname, INT8_MANIFEST_FILE)
+    if not (os.path.exists(path) and os.path.exists(man_path)):
+        if require:
+            raise ValueError(
+                "int8 serving requested but %r has no %s/%s export "
+                "(QuantizeTranspiler.export_int8 writes it)"
+                % (dirname, INT8_PARAMS_FILE, INT8_MANIFEST_FILE))
+        return []
+    import jax.numpy as jnp
+
+    with open(man_path) as f:
+        man = json.load(f)
+    qmax = float(2 ** (int(man.get("weight_bits", 8)) - 1) - 1)
+    data = np.load(path, allow_pickle=False)
+    loaded = []
+    for name, axis in man["weights"].items():
+        i8 = data[name]
+        scale = data[name + "@scale"]
+        shape = [1] * i8.ndim
+        shape[int(axis)] = -1
+        w = (i8.astype(np.float32) / qmax) * scale.reshape(shape)
+        scope.set(name, jnp.asarray(w))
+        loaded.append(name)
+    return loaded

@@ -1,4 +1,8 @@
-"""Attention + sampling op registrations (bridge to ``paddle_tpu.ops``)."""
+"""Attention + sampling op registrations (bridge to ``paddle_tpu.ops``),
+plus the incremental-decode primitives (KV-cache write + cached attention)
+the serving tier's step programs are built from."""
+
+import math
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +28,52 @@ def _flash_attention_op(env, op):
                           causal=op.attr("causal", False),
                           dropout_rate=dropout, rng=rng)
     put(env, op.output("Out"), out.astype(out_dtype))
+
+
+@register("kv_cache_write")
+def _kv_cache_write(env, op):
+    """Per-row cache update: Cache [B, C, ...], X [B, ...], Pos [B] ->
+    Out[b, Pos[b]] = X[b], other entries untouched. Each row writes ONLY
+    its own slot — the property the continuous batcher's solo-vs-batched
+    bitwise-parity guarantee rests on (a dead slot's garbage write cannot
+    leak into a live row). Out-of-range positions drop (a retired slot fed
+    a zero position is harmless either way)."""
+    cache = get(env, op.input("Cache"))
+    x = get(env, op.input("X"))
+    pos = get(env, op.input("Pos")).reshape(-1).astype(jnp.int32)
+    b = cache.shape[0]
+    put(env, op.output("Out"),
+        cache.at[jnp.arange(b), pos].set(x.astype(cache.dtype),
+                                         mode="drop"))
+
+
+@register("cached_attention")
+def _cached_attention(env, op):
+    """One-token attention over a fixed-capacity KV cache: Q [B, H*D],
+    CacheK/CacheV [B, C, H*D], Pos [B] (the index the current token was
+    just written at). Row b attends over cache positions <= Pos[b] only —
+    positions past the row's own fill level (including every slot of a
+    dead row) are masked out before the softmax. Numerics mirror
+    ``ops.flash_attention.mha_reference``: logits * 1/sqrt(D), f32
+    softmax. Strictly per-row: no cross-row reduction anywhere."""
+    q = get(env, op.input("Q"))
+    k = get(env, op.input("CacheK"))
+    v = get(env, op.input("CacheV"))
+    pos = get(env, op.input("Pos")).reshape(-1).astype(jnp.int32)
+    h = int(op.attr("num_heads", 1))
+    b, c, hd = k.shape
+    d = hd // h
+    qh = q.reshape(b, h, d)
+    kh = k.reshape(b, c, h, d)
+    vh = v.reshape(b, c, h, d)
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bhd,bchd->bhc", qh, kh) * scale
+    mask = jnp.arange(c)[None, None, :] <= pos[:, None, None]
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    ctx = jnp.einsum("bhc,bchd->bhd", probs, vh)
+    put(env, op.output("Out"), ctx.reshape(b, hd))
 
 
 @register("sampling_id")

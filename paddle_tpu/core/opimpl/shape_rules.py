@@ -767,3 +767,55 @@ def _arg_shape(ctx, op):
         return
     axis = _norm_axis(op.attr("axis", -1), len(xs))
     ctx.set(op.output("Out"), xs[:axis] + xs[axis + 1:], np.dtype(np.int32))
+
+
+# ---------------------------------------------------------------------------
+# incremental decode (KV-cache step programs — serving/decode_batcher.py)
+# ---------------------------------------------------------------------------
+
+@register_shape("kv_cache_write")
+def _kv_cache_write_shape(ctx, op):
+    cs = ctx.shape(op.input("Cache"))
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("Cache"))
+    if cs is not None and xs is not None:
+        if len(xs) != len(cs) - 1:
+            raise ShapeError(
+                "kv_cache_write X '%s' %s must be Cache '%s' %s minus the "
+                "capacity axis" % (op.input("X").name, list(xs),
+                                   op.input("Cache").name, list(cs)))
+        for a, b in zip((xs[0],) + tuple(xs[1:]),
+                        (cs[0],) + tuple(cs[2:])):
+            if a != -1 and b != -1 and a != b:
+                raise ShapeError(
+                    "kv_cache_write X '%s' %s does not slot into Cache "
+                    "'%s' %s" % (op.input("X").name, list(xs),
+                                 op.input("Cache").name, list(cs)))
+    ctx.set(op.output("Out"), cs, dt)
+
+
+@register_shape("cached_attention")
+def _cached_attention_shape(ctx, op):
+    qs = ctx.shape(op.input("Q"))
+    ks = ctx.shape(op.input("CacheK"))
+    vs = ctx.shape(op.input("CacheV"))
+    dt = ctx.dtype(op.input("Q"))
+    h = int(op.attr("num_heads", 1))
+    if ks is not None:
+        if len(ks) != 3:
+            raise ShapeError("cached_attention CacheK '%s' must be "
+                             "[B, C, H*D], got %s"
+                             % (op.input("CacheK").name, list(ks)))
+        if ks[-1] != -1 and ks[-1] % h != 0:
+            raise ShapeError(
+                "cached_attention CacheK '%s' last dim %d is not divisible "
+                "by num_heads=%d" % (op.input("CacheK").name, ks[-1], h))
+    if qs is not None and ks is not None and qs[-1] != -1 \
+            and ks[-1] != -1 and qs[-1] != ks[-1]:
+        raise ShapeError(
+            "cached_attention Q '%s' feature dim %d != CacheK '%s' dim %d"
+            % (op.input("Q").name, qs[-1], op.input("CacheK").name, ks[-1]))
+    if vs is None or qs is None:
+        ctx.set(op.output("Out"), qs, dt)
+        return
+    ctx.set(op.output("Out"), tuple(qs[:-1]) + (vs[-1],), dt)

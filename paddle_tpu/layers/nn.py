@@ -33,6 +33,7 @@ __all__ = [
     "one_hot", "lod_reset", "pad", "pad2d", "image_resize", "resize_bilinear",
     "resize_nearest", "grid_sampler", "pixel_shuffle", "im2sequence",
     "multi_head_attention", "scaled_dot_product_attention",
+    "cached_multi_head_attention", "kv_cache_write",
     "row_conv", "autoincreased_step_counter", "cos_sim",
     "split", "warpctc", "nce", "hsigmoid", "cumsum",
     "linear_chain_crf", "crf_decoding",
@@ -1730,3 +1731,60 @@ def multi_head_attention(queries, keys, values, attn_bias=None, d_key=None,
         dtype=dtype, shape=tuple(queries.shape[:-1]) + (d_model,))
     helper.append_op("matmul", {"X": ctx, "Y": wo}, {"Out": out}, {})
     return out
+
+
+def kv_cache_write(cache, x, pos, name=None):
+    """Per-row KV-cache update: ``cache[b, pos[b]] = x[b]`` (see
+    ``core/opimpl/attention_ops.py``). ``cache``: [B, C, ...], ``x``:
+    [B, ...], ``pos``: [B] int. Returns the updated cache tensor."""
+    helper = LayerHelper("kv_cache_write", name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(cache), shape=cache.shape)
+    helper.append_op("kv_cache_write",
+                     {"Cache": cache, "X": x, "Pos": pos}, {"Out": out}, {})
+    return out
+
+
+def cached_multi_head_attention(x, cache_k, cache_v, pos, d_model=None,
+                                n_head=1, name=None):
+    """One-token incremental attention sharing
+    :func:`multi_head_attention`'s weights (same ``name`` -> same
+    ``name.q/.k/.v/.out`` parameters), for KV-cached decode step programs:
+    project the current token ``x`` [B, d_model], write its K/V rows into
+    the fixed-capacity caches at each row's own ``pos``, attend over the
+    filled prefix, and apply the output projection. Returns
+    ``(out [B, d_model], new_cache_k, new_cache_v)`` — the updated caches
+    are carried by the decode scheduler between steps."""
+    helper = LayerHelper("cached_multi_head_attention", name=name)
+    d_model = d_model or x.shape[-1]
+    dtype = _dtype(x)
+
+    def proj(inp, tag):
+        w = helper.create_parameter(
+            ParamAttr(name=None if name is None else name + "." + tag,
+                      initializer=XavierInitializer(),
+                      sharding=(None, "mp")),
+            shape=[inp.shape[-1], d_model], dtype=dtype)
+        out = helper.create_variable_for_type_inference(
+            dtype=dtype, shape=tuple(inp.shape[:-1]) + (d_model,))
+        helper.append_op("matmul", {"X": inp, "Y": w}, {"Out": out}, {})
+        return out
+
+    q = proj(x, "q")
+    k = proj(x, "k")
+    v = proj(x, "v")
+    new_k = kv_cache_write(cache_k, k, pos, name=helper.name + "_kw")
+    new_v = kv_cache_write(cache_v, v, pos, name=helper.name + "_vw")
+    ctx = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=tuple(x.shape[:-1]) + (d_model,))
+    helper.append_op("cached_attention",
+                     {"Q": q, "CacheK": new_k, "CacheV": new_v, "Pos": pos},
+                     {"Out": ctx}, {"num_heads": n_head})
+    wo = helper.create_parameter(
+        ParamAttr(name=None if name is None else name + ".out",
+                  initializer=XavierInitializer(), sharding=("mp", None)),
+        shape=[d_model, d_model], dtype=dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=tuple(x.shape[:-1]) + (d_model,))
+    helper.append_op("matmul", {"X": ctx, "Y": wo}, {"Out": out}, {})
+    return out, new_k, new_v

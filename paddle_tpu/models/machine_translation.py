@@ -17,7 +17,8 @@ from .. import layers
 from ..core.param_attr import ParamAttr
 from .common import FeedSpec, ModelSpec
 
-__all__ = ["seq2seq_attention", "seq2seq_attention_infer"]
+__all__ = ["seq2seq_attention", "seq2seq_attention_infer",
+           "seq2seq_attention_greedy_infer"]
 
 
 def _p(name):
@@ -184,3 +185,37 @@ def seq2seq_attention_infer(src_vocab=10000, trg_vocab=10000, seq_len=50,
     sent_ids, sent_scores = layers.beam_search_decode(
         ids_arr, par_arr, step, pre_scores, k, eos_id)
     return sent_ids, sent_scores
+
+
+def seq2seq_attention_greedy_infer(src_vocab=10000, trg_vocab=10000,
+                                   seq_len=50, emb_dim=512, hid_dim=512,
+                                   max_out_len=None, bos_id=0, eos_id=1):
+    """Greedy decode program sharing the train program's parameters: the
+    beam program at K=1 squeezed to dense ``(ids [B, T], scores [B])``.
+    This is the one-shot serving entry (`ServingEngine.submit` with
+    ``src_ids``/``src_len`` feeds) and the static-batching A/B baseline
+    the continuous batcher is measured against: served one-shot, a batch
+    rides until its LONGEST member finishes.
+
+    Every per-step op is per-row (top-1, GRU, attention), so a request
+    batched with strangers decodes bitwise-identically to the same
+    request served solo at the same bucket rung — the property the
+    serving parity tests pin."""
+    from ..core.layer_helper import LayerHelper
+
+    sent_ids, sent_scores = seq2seq_attention_infer(
+        src_vocab=src_vocab, trg_vocab=trg_vocab, seq_len=seq_len,
+        emb_dim=emb_dim, hid_dim=hid_dim, beam_size=1,
+        max_out_len=max_out_len, bos_id=bos_id, eos_id=eos_id)
+    # the decode outputs' static shape is dynamic-length (None), so the
+    # beam axis squeezes through a raw op, not the shape-checked layer
+    helper = LayerHelper("mt_greedy")
+    ids = helper.create_variable_for_type_inference(dtype="int64",
+                                                    shape=None)
+    helper.append_op("squeeze", {"X": sent_ids}, {"Out": ids},
+                     {"axes": [1]})            # [B, 1, T] -> [B, T]
+    scores = helper.create_variable_for_type_inference(
+        dtype=str(sent_scores.dtype), shape=None)
+    helper.append_op("squeeze", {"X": sent_scores}, {"Out": scores},
+                     {"axes": [1]})            # [B, 1] -> [B]
+    return ids, scores

@@ -17,7 +17,8 @@ from .. import layers
 from ..core.param_attr import ParamAttr
 from .common import FeedSpec, ModelSpec
 
-__all__ = ["transformer_base", "transformer_flops_per_token"]
+__all__ = ["transformer_base", "transformer_flops_per_token",
+           "transformer_lm", "transformer_lm_step", "lm_step_config"]
 
 
 def _ffn(x, d_model, d_ff, name, moe_experts=0, moe_k=2, aux_losses=None):
@@ -141,6 +142,150 @@ def transformer_base(src_vocab=30000, trg_vocab=30000, seq_len=256,
         tokens_per_example=seq_len,
         sequence_feeds=["src_ids", "trg_ids", "lbl_ids"],
         extras={"enc_out": enc.name, "block_outs": block_outs})
+
+
+# ---------------------------------------------------------------------------
+# Decoder-only LM pair: a full-sequence causal program and the KV-cached
+# one-token step program the serving tier's continuous batcher drives.
+# Both builders name EVERY parameter explicitly (the machine_translation
+# train/infer pattern) so the two programs share weights through the scope.
+# ---------------------------------------------------------------------------
+
+def _named_ln(x, name, axis):
+    return layers.layer_norm(x, begin_norm_axis=axis,
+                             param_attr=ParamAttr(name=name + ".w"),
+                             bias_attr=ParamAttr(name=name + ".b"))
+
+
+def _lm_ffn(x, d_ff, d_model, nm, flat_dims):
+    h = layers.fc(x, size=d_ff, num_flatten_dims=flat_dims, act="relu",
+                  param_attr=ParamAttr(name=nm + "_ffn_fc1.w",
+                                       sharding=(None, "mp")),
+                  bias_attr=ParamAttr(name=nm + "_ffn_fc1.b"),
+                  name=nm + "_ffn_fc1")
+    return layers.fc(h, size=d_model, num_flatten_dims=flat_dims,
+                     param_attr=ParamAttr(name=nm + "_ffn_fc2.w",
+                                          sharding=("mp", None)),
+                     bias_attr=ParamAttr(name=nm + "_ffn_fc2.b"),
+                     name=nm + "_ffn_fc2")
+
+
+def _lm_embed(ids, pos, vocab, pos_cap, d_model):
+    word = layers.embedding(ids, size=[vocab, d_model],
+                            param_attr=ParamAttr(name="lm_word_emb"))
+    word = layers.scale(word, scale=float(d_model) ** 0.5)
+    posv = layers.embedding(pos, size=[pos_cap, d_model],
+                            param_attr=ParamAttr(name="lm_pos_emb"))
+    return layers.elementwise_add(word, posv)
+
+
+def transformer_lm(vocab=4000, seq_len=64, d_model=64, d_ff=128, n_head=4,
+                   n_layer=2, dropout_rate=0.0, pos_cap=512):
+    """Full-sequence causal LM (pre-norm decoder blocks, no cross
+    attention): the whole-sequence twin of :func:`transformer_lm_step`.
+    Train it (or just init) and the step program serves its weights.
+    ``dropout_rate`` defaults to 0 so full-vs-step logits agree exactly.
+    Extras carry the ``logits`` var name ([B, S, V])."""
+    assert seq_len <= pos_cap, "seq_len exceeds the shared pos table"
+    ids = layers.data("ids", shape=[seq_len], dtype="int64")
+    lbl = layers.data("lbl", shape=[seq_len], dtype="int64")
+    pos = layers.range(0, seq_len, 1, "int64")
+    x = _lm_embed(ids, pos, vocab, pos_cap, d_model)
+    if dropout_rate:
+        x = layers.dropout(x, dropout_rate)
+    for i in range(n_layer):
+        nm = "lm%d" % i
+        y = _named_ln(x, nm + "_attn_ln", 2)
+        a = layers.multi_head_attention(
+            y, y, y, d_model=d_model, n_head=n_head, causal=True,
+            dropout_rate=dropout_rate, name=nm + "_attn")
+        x = layers.elementwise_add(x, a)
+        f = _lm_ffn(_named_ln(x, nm + "_ffn_ln", 2), d_ff, d_model, nm, 2)
+        x = layers.elementwise_add(x, f)
+    x = _named_ln(x, "lm_ln", 2)
+    logits = layers.fc(x, size=vocab, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="lm_out.w",
+                                            sharding=(None, "mp")),
+                       bias_attr=False, name="lm_out")
+    ce = layers.squeeze(layers.softmax_with_cross_entropy(
+        logits, layers.unsqueeze(lbl, [2])), [2])
+    loss = layers.mean(ce)
+    per_layer = 4 * d_model * d_model + 2 * d_model * d_ff \
+        + 2 * seq_len * d_model
+    flops = 2 * 3 * (n_layer * per_layer + d_model * vocab) * seq_len
+    return ModelSpec(
+        loss,
+        feeds={"ids": FeedSpec([seq_len], "int64", 0, vocab),
+               "lbl": FeedSpec([seq_len], "int64", 0, vocab)},
+        flops_per_example=flops, tokens_per_example=seq_len,
+        sequence_feeds=["ids", "lbl"],
+        extras={"logits": logits.name})
+
+
+def lm_step_config(vocab=4000, d_model=64, d_ff=128, n_head=4, n_layer=2,
+                   ctx_cap=64, pos_cap=512):
+    """The shared kwargs dict for a :func:`transformer_lm` /
+    :func:`transformer_lm_step` pair (the two must agree on everything
+    but the sequence geometry)."""
+    return dict(vocab=vocab, d_model=d_model, d_ff=d_ff, n_head=n_head,
+                n_layer=n_layer, ctx_cap=ctx_cap, pos_cap=pos_cap)
+
+
+def transformer_lm_step(vocab=4000, d_model=64, d_ff=128, n_head=4,
+                        n_layer=2, ctx_cap=64, pos_cap=512):
+    """KV-cached one-token decode step program (the continuous batcher's
+    compiled unit, one executable per (batch rung, ctx rung)).
+
+    Feeds: ``tok_ids`` [B] (the token to ingest — a forced prompt token
+    or the previously sampled one), ``pos`` [B] int32 (each SLOT's own
+    fill level — rows advance independently, the heart of slot
+    recycling), and per layer ``cache_k_i`` / ``cache_v_i``
+    [B, C, d_model] with C chosen by the scheduler's ctx-bucket ladder
+    (declared -1: capacity is a bucket choice, not a program constant).
+    Fetches: next-token ``logits`` [B, vocab] then the updated caches —
+    carried state the scheduler feeds back next step, device-resident.
+
+    Returns ``(fetch_vars, decode_spec)``: the fetch Variables (for
+    ``save_inference_model``) and the plain-dict cache/feed layout
+    ``serving.decode_batcher.DecodeBatcher`` consumes."""
+    assert ctx_cap <= pos_cap, "ctx_cap exceeds the shared pos table"
+    tok = layers.data("tok_ids", shape=[], dtype="int64")
+    pos = layers.data("pos", shape=[], dtype="int32")
+    cache_in = []
+    for i in range(n_layer):
+        cache_in.append(
+            (layers.data("cache_k_%d" % i, shape=[-1, d_model]),
+             layers.data("cache_v_%d" % i, shape=[-1, d_model])))
+    x = _lm_embed(tok, pos, vocab, pos_cap, d_model)
+    cache_out = []
+    for i in range(n_layer):
+        nm = "lm%d" % i
+        ck, cv = cache_in[i]
+        a, nk, nv = layers.cached_multi_head_attention(
+            _named_ln(x, nm + "_attn_ln", 1), ck, cv, pos,
+            d_model=d_model, n_head=n_head, name=nm + "_attn")
+        cache_out.append((nk, nv))
+        x = layers.elementwise_add(x, a)
+        f = _lm_ffn(_named_ln(x, nm + "_ffn_ln", 1), d_ff, d_model, nm, 1)
+        x = layers.elementwise_add(x, f)
+    x = _named_ln(x, "lm_ln", 1)
+    logits = layers.fc(x, size=vocab,
+                       param_attr=ParamAttr(name="lm_out.w",
+                                            sharding=(None, "mp")),
+                       bias_attr=False, name="lm_out")
+    fetch_vars = [logits]
+    cache_feeds = []
+    for i, (nk, nv) in enumerate(cache_out):
+        fetch_vars += [nk, nv]
+        cache_feeds += [
+            {"feed": "cache_k_%d" % i, "fetch": nk.name,
+             "tail": [d_model], "dtype": "float32"},
+            {"feed": "cache_v_%d" % i, "fetch": nv.name,
+             "tail": [d_model], "dtype": "float32"}]
+    decode_spec = {"token_feed": "tok_ids", "pos_feed": "pos",
+                   "logits_fetch": logits.name, "cache_feeds": cache_feeds,
+                   "vocab": vocab, "ctx_cap": ctx_cap}
+    return fetch_vars, decode_spec
 
 
 def transformer_flops_per_token(src_vocab, trg_vocab, seq_len, d_model, d_ff,

@@ -22,10 +22,14 @@ from .admission import (AdmissionController, DeadlineExceededError,  # noqa: F40
 from .batcher import DynamicBatcher, Request  # noqa: F401
 from .buckets import (BucketError, bucket_for, pad_to_bucket,  # noqa: F401
                       pow2_ladder, unpad_fetch)
+from .decode_batcher import (DecodeBatcher, DecodeRequest,  # noqa: F401
+                             load_decode_spec, save_decode_spec)
 from .engine import EngineShutdownError, ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
 
 __all__ = ["ServingEngine", "EngineShutdownError", "DynamicBatcher",
            "Request", "ServingMetrics", "AdmissionController",
            "ServerOverloadedError", "DeadlineExceededError", "BucketError",
-           "pow2_ladder", "bucket_for", "pad_to_bucket", "unpad_fetch"]
+           "pow2_ladder", "bucket_for", "pad_to_bucket", "unpad_fetch",
+           "DecodeBatcher", "DecodeRequest", "save_decode_spec",
+           "load_decode_spec"]

@@ -1,0 +1,509 @@
+"""Continuous batching for autoregressive decode: a slot-recycled
+scheduler over a KV-cached one-token step program.
+
+The one-shot engine (``engine.py``) serves whole requests: a batch rides
+until its LONGEST member finishes, so one 512-token generation stalls
+every 8-token request batched with it. This scheduler makes the decode
+STEP the scheduling quantum instead (the established continuous-batching
+design the reference, whose serving story ends at
+``AnalysisPredictor::Clone``, has no analog for):
+
+  * a **slot table** of ``bucket_batch`` rows, each row one in-flight
+    request with its own fill level ``pos`` into fixed-capacity per-slot
+    KV-cache tensors ([B, C, ...] per layer, carried between steps as
+    device-resident fetch->feed state — never a host round trip);
+  * one compiled step per ``(bucket_batch, bucket_ctx)`` on the pow2
+    ladders (``buckets.py``), so the XLA compile cache stays bounded at
+    ``len(ladder) * len(ctx_ladder)`` executables;
+  * **slot recycling**: new requests are admitted into free slots BETWEEN
+    steps and finished sequences retire immediately — a long generation
+    never blocks short co-riders, it just keeps its one slot;
+  * **re-bucketing** when occupancy crosses a ladder boundary: the slot
+    table compacts/grows and caches are copied row-wise into the new
+    geometry (rare, host-side, O(B*C*D));
+  * prompt tokens are ingested through the same step function (one forced
+    token per step) — no separate prefill executable, so the compile
+    cache bound holds and a long prompt shares steps with everyone else.
+
+**Exact-parity guarantee.** Every op in a step program is strictly
+per-row (``cached_attention`` masks each row to its own fill level;
+``kv_cache_write`` writes only the row's own slot; matmul/layernorm
+reduce over feature axes only). A dead or stranger row therefore cannot
+perturb a live row: at a fixed (bucket_batch, bucket_ctx) geometry,
+batched-with-strangers output is BITWISE-identical to solo decode —
+``tests/test_serving.py`` pins this for greedy (here) and beam (the
+one-shot path). Across different bucket geometries the math is identical
+per row but runs in different executables, so parity there is
+floating-point-deterministic, not contractual.
+
+Sampling is host-side greedy argmax over the fetched next-token logits
+row: deterministic, per-row, and it keeps eos/length control flow out of
+the compiled step.
+"""
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from .admission import AdmissionController, DeadlineExceededError
+from .buckets import bucket_for, pow2_ladder
+from .engine import EngineShutdownError
+from .metrics import ServingMetrics
+
+__all__ = ["DecodeBatcher", "DecodeRequest", "save_decode_spec",
+           "load_decode_spec"]
+
+DECODE_SPEC_FILE = "decode_spec.json"
+
+
+def save_decode_spec(dirname, spec):
+    """Write a step builder's decode-spec dict next to a
+    ``save_inference_model`` export, so ``ServingEngine(dir, decode=True)``
+    can serve continuous-batching decode straight from the directory."""
+    import json
+    import os
+
+    path = os.path.join(dirname, DECODE_SPEC_FILE)
+    with open(path, "w") as f:
+        json.dump(spec, f, indent=1)
+    return path
+
+
+def load_decode_spec(dirname):
+    import json
+    import os
+
+    with open(os.path.join(dirname, DECODE_SPEC_FILE)) as f:
+        return json.load(f)
+
+
+class DecodeRequest:
+    """One decode request: ``prompt`` (1-D int token ids, non-empty),
+    ``max_new_tokens``, optional ``eos_id`` (stop token, also emitted),
+    the caller's future (resolves to the generated ids as int64 ndarray),
+    and the admission timestamps the TTFT/TPOT metrics read."""
+
+    __slots__ = ("prompt", "max_new", "eos_id", "future", "enqueue_t",
+                 "deadline", "n_ctx")
+
+    def __init__(self, prompt, max_new, eos_id, future, enqueue_t,
+                 deadline=None):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new = int(max_new)
+        self.eos_id = eos_id
+        self.future = future
+        self.enqueue_t = enqueue_t
+        self.deadline = deadline
+        # cache capacity this request needs: every prompt token is written
+        # once, then at most max_new-1 generated tokens are fed back (the
+        # last sampled token never re-enters the cache), so the highest
+        # index written is prompt+max_new-2
+        self.n_ctx = len(self.prompt) + self.max_new - 1
+
+    def __repr__(self):
+        return ("DecodeRequest(prompt=%d toks, max_new=%d)"
+                % (len(self.prompt), self.max_new))
+
+
+class _Slot:
+    """One occupied slot-table row."""
+
+    __slots__ = ("req", "pos", "k", "out", "next_token", "first_tok_t")
+
+    def __init__(self, req):
+        self.req = req
+        self.pos = 0            # next cache index == tokens ingested
+        self.k = 1              # prompt cursor: prompt[0] feeds first
+        self.out = []           # generated ids
+        self.next_token = req.prompt[0]
+        self.first_tok_t = None
+
+    @property
+    def forcing(self):
+        """Still ingesting prompt tokens (logits ignored)."""
+        return self.k < len(self.req.prompt)
+
+
+class DecodeBatcher:
+    """The continuous batcher. Same client surface as ``ServingEngine``
+    (``submit``/``predict``/``metrics``/``warmup``/``shutdown``) so the
+    engine can put it behind one API.
+
+    ``predictor``: anything with ``run(feed, return_numpy=False) -> list``
+    in fetch order and ``fetch_names`` — a ``Predictor`` over a saved
+    step-program dir, an in-process ``ProgramPredictor``, or a test fake.
+    ``spec``: the decode-spec dict a step builder returns
+    (``models.transformer.transformer_lm_step``): token/pos feed names,
+    logits fetch, per-layer cache feed/fetch pairs with tail shapes.
+
+    ``start=False`` skips the loop thread: tests then call
+    :meth:`drive` to run the scheduler synchronously — fully
+    deterministic, zero sleeps (the injectable-``clock`` contract the
+    rest of the serving tier follows)."""
+
+    def __init__(self, predictor, spec, ladder=None, ctx_ladder=None,
+                 max_batch_size=8, max_queue_depth=256,
+                 default_timeout_s=None, default_max_new_tokens=64,
+                 eos_id=None, clock=None, metrics=None, start=True):
+        self._predictor = predictor
+        self._spec = dict(spec)
+        self._tok_feed = self._spec["token_feed"]
+        self._pos_feed = self._spec["pos_feed"]
+        fetch_names = list(predictor.fetch_names)
+        self._logits_idx = fetch_names.index(self._spec["logits_fetch"])
+        self._cache_feeds = []
+        for cf in self._spec["cache_feeds"]:
+            self._cache_feeds.append(
+                (cf["feed"], fetch_names.index(cf["fetch"]),
+                 tuple(cf["tail"]), np.dtype(cf.get("dtype", "float32"))))
+        self.ladder = tuple(sorted(set(
+            ladder if ladder is not None else pow2_ladder(max_batch_size))))
+        if ctx_ladder is None:
+            cap = int(self._spec.get("ctx_cap", 256))
+            ctx_ladder = [r for r in pow2_ladder(cap) if r >= 16] or [cap]
+        self.ctx_ladder = tuple(sorted(set(int(c) for c in ctx_ladder)))
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.default_timeout_s = default_timeout_s
+        self.eos_id = eos_id
+        self._clock = clock or time.monotonic
+        self._admission = AdmissionController(max_queue_depth)
+        if metrics is not None:
+            # shared instance (the engine's): the OWNER binds aggregate
+            # gauges across every batcher — binding here would leave the
+            # gauges reading whichever replica bound last
+            self.metrics_ = metrics
+        else:
+            self.metrics_ = ServingMetrics()
+            self.metrics_.bind_gauges(lambda: len(self._pending),
+                                      lambda: self._admission.in_flight)
+
+        self._pending = deque()
+        self._slots = []          # list[_Slot | None], len == bucket_batch
+        self._caches = {}         # feed name -> [B, C, *tail] array
+        self._bucket = (0, 0)     # (bucket_batch, bucket_ctx)
+        self.seen_signatures = set()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._aborted = False
+        self._thread = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, name="paddle-tpu-decode", daemon=True)
+            self._thread.start()
+
+    # -- client surface -----------------------------------------------------
+    def now(self):
+        return self._clock()
+
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               timeout_s=None):
+        """Enqueue one decode request; returns a Future resolving to the
+        generated token ids (int64 ndarray, eos included when hit).
+        Raises ``BucketError`` when prompt+max_new exceeds the top ctx
+        rung, ``ServerOverloadedError`` when the bounded queue is full."""
+        if self._closed:
+            raise RuntimeError("DecodeBatcher is shut down")
+        prompt = np.asarray(prompt).reshape(-1)
+        if prompt.size == 0:
+            raise ValueError("prompt must hold at least one token")
+        max_new = (int(max_new_tokens) if max_new_tokens is not None
+                   else self.default_max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        eos = eos_id if eos_id is not None else self.eos_id
+        # matches DecodeRequest.n_ctx: the last sampled token never
+        # re-enters the cache
+        n_ctx = int(prompt.size) + max_new - 1
+        bucket_for(n_ctx, self.ctx_ladder)  # validates at the door
+        timeout_s = (timeout_s if timeout_s is not None
+                     else self.default_timeout_s)
+        now = self._clock()
+        deadline = now + timeout_s if timeout_s is not None else None
+        self._admission.acquire(1)
+        req = DecodeRequest(prompt, max_new, eos, Future(), now,
+                            deadline=deadline)
+        with self._cv:
+            if self._closed:
+                self._admission.release(1)
+                raise RuntimeError("DecodeBatcher is shut down")
+            self._pending.append(req)
+            self._cv.notify_all()
+        return req.future
+
+    def predict(self, prompt, max_new_tokens=None, eos_id=None,
+                timeout_s=None):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(prompt, max_new_tokens=max_new_tokens,
+                           eos_id=eos_id,
+                           timeout_s=timeout_s).result(timeout_s)
+
+    def metrics(self):
+        return self.metrics_.snapshot()
+
+    def metrics_report(self):
+        return self.metrics_.report()
+
+    def compiled_shape_counts(self):
+        """Distinct (bucket_batch, bucket_ctx) geometries dispatched —
+        bounded at ``len(ladder) * len(ctx_ladder)`` by construction."""
+        return [len(self.seen_signatures)]
+
+    def warmup(self):
+        """Pre-compile every (batch rung, ctx rung) geometry with a
+        zero-token synthetic step, so live traffic never compiles.
+        Returns the number of geometries warmed."""
+        warmed = 0
+        for b in self.ladder:
+            for c in self.ctx_ladder:
+                feed = self._synth_feed(b, c)
+                self._predictor.run(feed, return_numpy=False)
+                self.seen_signatures.add((b, c))
+                warmed += 1
+        return warmed
+
+    def drive(self, max_steps=None):
+        """Run the scheduler loop synchronously on the CALLING thread
+        until idle (or ``max_steps`` decode steps). Only valid with
+        ``start=False`` — the deterministic test/bench mode. Returns the
+        number of steps executed."""
+        if self._thread is not None:
+            raise RuntimeError("drive() requires start=False "
+                               "(the loop thread owns the slot table)")
+        steps = 0
+        while max_steps is None or steps < max_steps:
+            self._admit()
+            if not any(s is not None for s in self._slots):
+                break
+            self._step_once()
+            steps += 1
+        return steps
+
+    def shutdown(self, drain=True, timeout_s=None):
+        """Stop intake. ``drain=True`` serves everything already
+        submitted — queued requests included — to completion;
+        ``drain=False`` aborts: in-flight generation and queued requests
+        both fail with :class:`EngineShutdownError`."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            self._aborted = not drain
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout_s if timeout_s is not None else 30.0)
+        else:
+            if drain:
+                while True:
+                    self._admit()
+                    if not any(s is not None for s in self._slots):
+                        break
+                    self._step_once()
+            else:
+                self._abort_live()
+        self._fail_pending()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown(drain=exc[0] is None)
+
+    # -- scheduler ----------------------------------------------------------
+    def _loop(self):
+        while True:
+            with self._cv:
+                while (not self._closed and not self._pending
+                       and not any(s is not None for s in self._slots)):
+                    self._cv.wait()
+                if self._closed:
+                    if self._aborted:
+                        break
+                    if (not any(s is not None for s in self._slots)
+                            and not self._pending):
+                        break
+            try:
+                self._admit()
+                if any(s is not None for s in self._slots):
+                    self._step_once()
+                elif self._closed:
+                    break
+            except BaseException as e:  # noqa: BLE001 — fail loudly, once
+                self._poison(e)
+                return
+        if self._aborted:
+            self._abort_live()
+
+    def _poison(self, exc):
+        """The step function itself threw (a replica fault, not a request
+        fault): fail everything in flight — a decode loop cannot retry
+        mid-sequence without replaying the whole cache."""
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._resolve_exc(slot.req, exc)
+                self._slots[i] = None
+        self._fail_pending(exc)
+
+    def _fail_pending(self, exc=None):
+        with self._cv:
+            pending = list(self._pending)
+            self._pending.clear()
+        for req in pending:
+            self._resolve_exc(req, exc or EngineShutdownError(
+                "DecodeBatcher shut down before this request started"))
+            self.metrics_.observe_failed()
+
+    def _abort_live(self):
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._resolve_exc(slot.req, EngineShutdownError(
+                    "DecodeBatcher aborted mid-generation"))
+                self.metrics_.observe_failed()
+                self._slots[i] = None
+
+    def _resolve_exc(self, req, exc):
+        try:
+            req.future.set_exception(exc)
+        except Exception:
+            pass
+        self._admission.release(1)
+
+    # admission + re-bucketing — runs BETWEEN steps only (slot recycling)
+    def _admit(self):
+        now = self._clock()
+        admitted = []
+        with self._cv:
+            live = sum(1 for s in self._slots if s is not None)
+            room = max(self.ladder) - live
+            while self._pending and room > 0:
+                req = self._pending.popleft()
+                if req.deadline is not None and now > req.deadline:
+                    self._resolve_exc(req, DeadlineExceededError(
+                        "request waited %.1f ms, deadline was %.1f ms"
+                        % ((now - req.enqueue_t) * 1e3,
+                           (req.deadline - req.enqueue_t) * 1e3)))
+                    self.metrics_.observe_expired()
+                    continue
+                admitted.append(req)
+                room -= 1
+        if not admitted and self._bucket == self._target_bucket([]):
+            return
+        self._rebucket(admitted)
+
+    def _target_bucket(self, admitting):
+        live = [s.req for s in self._slots if s is not None]
+        reqs = live + list(admitting)
+        if not reqs:
+            return (0, 0)
+        b = bucket_for(len(reqs), self.ladder)
+        c = bucket_for(max(r.n_ctx for r in reqs), self.ctx_ladder)
+        return (b, c)
+
+    def _rebucket(self, admitting):
+        """Place admissions and re-shape the caches when the (batch, ctx)
+        bucket moved.
+
+        Same geometry: admitted requests drop into free HOLES — zero
+        cache traffic, because a recycled row needs no cleaning (its new
+        occupant starts at pos 0 and a row's attention mask never reaches
+        past its own fill level, so the previous occupant's leftovers are
+        unreachable). This is what keeps steady-state slot recycling off
+        the host.
+
+        Geometry moved (occupancy crossed a ladder rung, or a longer
+        request raised the ctx rung): live rows compact into fresh
+        zero arrays — the one host-side copy re-bucketing costs."""
+        new_b, new_c = self._target_bucket(admitting)
+        if (new_b, new_c) == self._bucket:
+            if admitting:
+                free = [i for i, s in enumerate(self._slots) if s is None]
+                for req, i in zip(admitting, free):
+                    self._slots[i] = _Slot(req)
+            return
+        old_c = self._bucket[1]
+        live = [(i, s) for i, s in enumerate(self._slots) if s is not None]
+        new_slots = [s for _, s in live]
+        for req in admitting:
+            new_slots.append(_Slot(req))
+        new_slots += [None] * (new_b - len(new_slots))
+        copy_c = min(old_c, new_c)
+        for feed, _idx, tail, dtype in self._cache_feeds:
+            old = self._caches.get(feed)
+            new = np.zeros((new_b, new_c) + tail, dtype)
+            if old is not None and live:
+                old = np.asarray(old)
+                for j, (i, _s) in enumerate(live):
+                    new[j, :copy_c] = old[i, :copy_c]
+            self._caches[feed] = new
+        self._slots = new_slots
+        self._bucket = (new_b, new_c)
+
+    def _synth_feed(self, b, c):
+        feed = {self._tok_feed: np.zeros((b,), np.int64),
+                self._pos_feed: np.zeros((b,), np.int32)}
+        for name, _idx, tail, dtype in self._cache_feeds:
+            feed[name] = np.zeros((b, c) + tail, dtype)
+        return feed
+
+    def _step_once(self):
+        b, c = self._bucket
+        toks = np.zeros((b,), np.int64)
+        pos = np.zeros((b,), np.int32)
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                toks[i] = slot.next_token
+                pos[i] = slot.pos
+        feed = dict(self._caches)
+        feed[self._tok_feed] = toks
+        feed[self._pos_feed] = pos
+        outs = self._predictor.run(feed, return_numpy=False)
+        sig = (b, c)
+        self.seen_signatures.add(sig)
+        # carried state: fetched cache arrays feed the next step as-is
+        # (device-resident jax arrays round-trip through the feed dict
+        # without touching the host)
+        for name, idx, _tail, _dtype in self._cache_feeds:
+            self._caches[name] = outs[idx]
+        logits = np.asarray(outs[self._logits_idx])
+        now = self._clock()
+        live = 0
+        generated = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            live += 1
+            slot.pos += 1
+            if slot.forcing:
+                slot.next_token = slot.req.prompt[slot.k]
+                slot.k += 1
+                continue
+            nxt = int(np.argmax(logits[i]))
+            generated += 1
+            slot.out.append(nxt)
+            if slot.first_tok_t is None:
+                slot.first_tok_t = now
+                self.metrics_.observe_ttft(now - slot.req.enqueue_t)
+            done = (len(slot.out) >= slot.req.max_new
+                    or (slot.req.eos_id is not None
+                        and nxt == slot.req.eos_id))
+            if done:
+                self._retire(i, slot, now)
+            else:
+                slot.next_token = nxt
+        self.metrics_.observe_decode_step(live, b, generated)
+
+    def _retire(self, i, slot, now):
+        """Finished sequence: resolve, free the slot IMMEDIATELY (the
+        next ``_admit`` recycles it — no drain barrier)."""
+        self._slots[i] = None
+        if len(slot.out) > 1:
+            self.metrics_.observe_tpot(
+                (now - slot.first_tok_t) / (len(slot.out) - 1))
+        try:
+            slot.req.future.set_result(np.asarray(slot.out, np.int64))
+        except Exception:
+            pass
+        self.metrics_.observe_completed(now - slot.req.enqueue_t)
+        self._admission.release(1)

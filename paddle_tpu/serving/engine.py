@@ -73,11 +73,35 @@ class ServingEngine:
                  max_queue_depth=256, default_timeout_s=None, clock=None,
                  latency_window=8192, max_replica_failures=3,
                  cross_replica_retry=True, shed_on_overload=True,
-                 supervisor_interval_s=0.05):
+                 supervisor_interval_s=0.05, placement="single", mp=1,
+                 devices=None, decode=None, default_max_new_tokens=64,
+                 eos_id=None):
         """``model``: a model directory / ``AnalysisConfig`` (loaded via
         ``Predictor``), or an already-constructed predictor exposing
-        ``run``/``clone``/``feed_names`` (``Predictor`` or
-        ``StableHLOPredictor``).
+        ``run``/``clone``/``feed_names`` (``Predictor``,
+        ``ProgramPredictor`` or ``StableHLOPredictor``).
+
+        Placement (ISSUE 14): ``placement="per_device"`` pins replicas
+        round-robin over ``devices`` (default ``jax.devices()``) via
+        ``clone(device=...)`` — each replica's weights live on its own
+        chip instead of all landing on device 0. ``mp=k`` serves a
+        tensor-parallel predictor sharded over a k-device ``("mp",)``
+        mesh per the program's ``ParamAttr(sharding=...)`` annotations
+        (``Predictor.shard``); at build the compiled step is asserted to
+        KEEP annotated params sharded (``parallel/sharding_check``) — an
+        accidental full replication fails construction, not production.
+        With both, devices are partitioned into ``len(devices)//mp``
+        groups and replicas round-robin the groups.
+
+        Decode (continuous batching): pass ``decode=<decode-spec dict>``
+        (a step builder's spec, e.g. ``transformer_lm_step``) — or
+        ``decode=True`` with a model dir carrying ``decode_spec.json`` —
+        and the engine serves autoregressive generation through a
+        slot-recycled :class:`~.decode_batcher.DecodeBatcher` per replica
+        behind the same ``submit()``/``predict()`` API: feeds become
+        ``submit(prompt_ids, max_new_tokens=..., eos_id=...)`` and the
+        future resolves to the generated ids. ``ladder`` bounds the slot
+        table, ``seq_ladder`` the KV-cache capacity rungs.
 
         Reliability knobs: ``max_replica_failures`` consecutive batch
         failures evict a replica and rebuild it from the parent
@@ -89,7 +113,12 @@ class ServingEngine:
         (``None`` disables the supervisor)."""
         if num_replicas < 1:
             raise ValueError("num_replicas must be >= 1")
+        if placement not in ("single", "per_device"):
+            raise ValueError("placement must be 'single' or 'per_device', "
+                             "got %r" % (placement,))
         faults.maybe_install_from_env()
+        model_dir = model.model_dir if isinstance(model, AnalysisConfig) \
+            else (model if isinstance(model, str) else None)
         if isinstance(model, (str, AnalysisConfig)):
             model = Predictor(model)
         if not callable(getattr(model, "clone", None)):
@@ -101,6 +130,8 @@ class ServingEngine:
         self.max_batch_size = max(self.ladder)
         self.feed_names = list(getattr(model, "feed_names", []))
         self.default_timeout_s = default_timeout_s
+        self.placement = placement
+        self.mp = int(mp)
 
         self._batcher = DynamicBatcher(self.max_batch_size,
                                        max_wait_ms=max_wait_ms, clock=clock)
@@ -109,19 +140,54 @@ class ServingEngine:
         self.metrics_.bind_gauges(self._batcher.depth,
                                   lambda: self._admission.in_flight)
 
-        self._parent = model
+        parents = self._build_parents(model, placement, self.mp, devices,
+                                      num_replicas)
+        self._parent = parents[0]
         self.max_replica_failures = max_replica_failures or 0
         self.cross_replica_retry = bool(cross_replica_retry)
         self.shed_on_overload = bool(shed_on_overload)
+
+        # decode mode: continuous batching replaces the one-shot pipeline
+        decode_spec = self._resolve_decode_spec(decode, model_dir)
+        self._decoders = None
+        if decode_spec is not None:
+            from .decode_batcher import DecodeBatcher
+
+            self._decoders = []
+            for i in range(num_replicas):
+                parent = parents[i % len(parents)]
+                pred = parent if i < len(parents) else parent.clone()
+                self._decoders.append(DecodeBatcher(
+                    pred, decode_spec, ladder=self.ladder,
+                    ctx_ladder=self.seq_ladder,
+                    max_queue_depth=max_queue_depth,
+                    default_timeout_s=default_timeout_s,
+                    default_max_new_tokens=default_max_new_tokens,
+                    eos_id=eos_id, clock=clock, metrics=self.metrics_))
+            # aggregate gauges over every replica's queue (each batcher
+            # got the shared metrics and deliberately did NOT bind its
+            # own — a per-replica bind would report only the last one)
+            decoders = self._decoders
+            self.metrics_.bind_gauges(
+                lambda: sum(len(d._pending) for d in decoders),
+                lambda: sum(d._admission.in_flight for d in decoders))
+            self._workers = []
+            self._closed = False
+            self._shutdown_done = False
+            self._stop_event = threading.Event()
+            self._supervisor = None
+            return
 
         def breaker():
             return CircuitBreaker(
                 failure_threshold=max(1, self.max_replica_failures or 1),
                 reset_timeout_s=0.0, clock=self._batcher.now)
 
-        self._workers = [_Worker(model, 0, breaker())]
-        for i in range(num_replicas - 1):
-            self._workers.append(_Worker(model.clone(), i + 1, breaker()))
+        self._workers = []
+        for i in range(num_replicas):
+            parent = parents[i % len(parents)]
+            pred = parent if i < len(parents) else parent.clone()
+            self._workers.append(_Worker(pred, i, breaker()))
         self._closed = False
         self._shutdown_done = False
         self._stop_event = threading.Event()
@@ -134,16 +200,136 @@ class ServingEngine:
                 name="paddle-tpu-serve-supervisor", daemon=True)
             self._supervisor.start()
 
+    # -- placement ----------------------------------------------------------
+    @staticmethod
+    def _resolve_decode_spec(decode, model_dir):
+        if decode is None or decode is False:
+            return None
+        if isinstance(decode, dict):
+            return decode
+        if decode is True:
+            if model_dir is None:
+                raise ValueError("decode=True needs a model DIRECTORY "
+                                 "carrying decode_spec.json; pass the "
+                                 "spec dict for in-process predictors")
+            from .decode_batcher import load_decode_spec
+
+            return load_decode_spec(model_dir)
+        raise TypeError("decode must be None, True, or a decode-spec "
+                        "dict; got %r" % (decode,))
+
+    def _build_parents(self, model, placement, mp, devices, num_replicas):
+        """The replica-parent predictors placement produces: one
+        weight-holder per device (per_device), per device GROUP (mp>1 +
+        per_device), one mesh-sharded parent (mp>1), or just ``model``.
+        Never more parents than replicas — an unused parent is an unused
+        HBM-resident weight copy. mp parents are sharding-asserted
+        before any replica clones."""
+        if placement == "single" and mp <= 1:
+            return [model]
+        import jax
+
+        devices = list(devices) if devices is not None else jax.devices()
+        if mp > 1:
+            import numpy as np
+            from jax.sharding import Mesh
+
+            if len(devices) < mp:
+                raise ValueError(
+                    "mp=%d needs %d devices, found %d"
+                    % (mp, mp, len(devices)))
+            if not callable(getattr(model, "shard", None)):
+                raise TypeError(
+                    "mp>1 needs a program-path predictor with .shard() "
+                    "(Predictor/ProgramPredictor); got %r" % (model,))
+            n_groups = (len(devices) // mp if placement == "per_device"
+                        else 1)
+            n_groups = max(1, min(n_groups, num_replicas))
+            parents = []
+            for g in range(n_groups):
+                mesh = Mesh(np.array(devices[g * mp:(g + 1) * mp]),
+                            ("mp",))
+                parent = model.shard(mesh)
+                self._assert_mp_sharded(parent, mesh)
+                parents.append(parent)
+            return parents
+        # per_device, mp=1: one pinned weight copy per device in use
+        return [model.clone(device=d)
+                for d in devices[:max(1, min(len(devices), num_replicas))]]
+
+    def _assert_mp_sharded(self, parent, mesh):
+        """Build-time HLO assertion (``parallel/sharding_check``): every
+        mp-annotated >=2-D parameter must enter the compiled step
+        actually sharded, and no all-gather may reassemble one — the
+        failure mode where GSPMD silently replicates a 'sharded' model
+        and mp=k buys k chips of nothing."""
+        from ..parallel import sharding_check
+
+        prog = getattr(parent, "_program", None)
+        if prog is None:
+            return
+        mesh_axes = set(mesh.axis_names)
+        annotated = []
+        for v in prog.list_vars():
+            spec = getattr(v, "sharding", None)
+            if not v.persistable or spec is None:
+                continue
+            if not any(a in mesh_axes for a in spec if a is not None):
+                continue
+            if v.shape is None or len(v.shape) < 2 or \
+                    any(d is None or d < 0 for d in v.shape):
+                continue
+            annotated.append(v)
+        if not annotated:
+            warnings.warn(
+                "mp=%d serving: the program carries no mp-annotated "
+                "parameters — every weight will be fully replicated "
+                "and tensor parallelism buys nothing"
+                % mesh.devices.size, RuntimeWarning, stacklevel=3)
+            return
+        feed = self._synthesize_example_for(parent)
+        padded, _ = pad_to_bucket(feed, (min(self.ladder),),
+                                  seq_ladder=(min(self.seq_ladder),)
+                                  if self.seq_ladder else None)
+        parent.run(padded)
+        hlo = parent._exe.lowered_hlo_text()
+        for v in annotated:
+            sharding_check.assert_param_sharded(hlo, v.name,
+                                                logical_shape=v.shape)
+        sharding_check.assert_no_param_allgather(
+            hlo, [tuple(v.shape) for v in annotated])
+
     # -- client surface -----------------------------------------------------
-    def submit(self, feed, timeout_s=None):
-        """Enqueue one request; returns a ``concurrent.futures.Future``
-        resolving to the fetch list (arrays sliced to this request's rows).
+    def submit(self, feed, timeout_s=None, max_new_tokens=None,
+               eos_id=None):
+        """Enqueue one request; returns a ``concurrent.futures.Future``.
+
+        One-shot mode: ``feed`` is the usual dict/list of arrays and the
+        future resolves to the fetch list (sliced to this request's
+        rows). Decode mode (``decode=`` at construction): ``feed`` is the
+        prompt — a 1-D int array/list, or a dict with a single
+        ``prompt_ids`` entry — and the future resolves to the generated
+        token ids; the request is continuously batched per STEP, so it
+        shares every decode step with whatever else is in flight and
+        retires the moment it finishes.
 
         Raises :class:`ServerOverloadedError` immediately when the bounded
         queue is full, ``BucketError`` when the request's batch exceeds the
         top rung, ``RuntimeError`` after shutdown."""
         if self._closed:
             raise RuntimeError("ServingEngine is shut down")
+        if self._decoders is not None:
+            if isinstance(feed, dict):
+                if set(feed) != {"prompt_ids"}:
+                    raise ValueError(
+                        "decode-mode submit takes a prompt (1-D ids) or "
+                        "{'prompt_ids': ids}; got keys %s" % sorted(feed))
+                feed = feed["prompt_ids"]
+            # least-loaded replica: pending + occupied slots
+            dec = min(self._decoders,
+                      key=lambda d: d._admission.in_flight)
+            return dec.submit(feed, max_new_tokens=max_new_tokens,
+                              eos_id=eos_id, timeout_s=timeout_s)
         if isinstance(feed, (list, tuple)):
             if len(feed) != len(self.feed_names):
                 raise ValueError("expected %d inputs (%s), got %d"
@@ -206,9 +392,10 @@ class ServingEngine:
             raise RuntimeError("ServingEngine is shut down")
         return req.future
 
-    def predict(self, feed, timeout_s=None):
+    def predict(self, feed, timeout_s=None, **decode_kw):
         """Synchronous convenience: submit + wait."""
-        return self.submit(feed, timeout_s=timeout_s).result(timeout_s)
+        return self.submit(feed, timeout_s=timeout_s,
+                           **decode_kw).result(timeout_s)
 
     def warmup(self, example_feed=None):
         """Pre-compile every (batch rung x seq rung) bucket on every
@@ -221,6 +408,8 @@ class ServingEngine:
         actually warmed."""
         from .buckets import BucketError
 
+        if self._decoders is not None:
+            return sum(d.warmup() for d in self._decoders)
         feed = example_feed
         if feed is None:
             feed = self._synthesize_example()
@@ -252,6 +441,9 @@ class ServingEngine:
         ladder guarantees (<= len(ladder), or len(ladder)*len(seq_ladder)
         with sequence bucketing). For program-path replicas this mirrors
         the Executor's real compile-cache size."""
+        if self._decoders is not None:
+            return [c for d in self._decoders
+                    for c in d.compiled_shape_counts()]
         return [len(w.seen_signatures) for w in self._workers]
 
     def shutdown(self, drain=True, timeout_s=None):
@@ -266,6 +458,10 @@ class ServingEngine:
             return
         self._shutdown_done = True
         self._stop_event.set()
+        if self._decoders is not None:
+            for d in self._decoders:
+                d.shutdown(drain=drain, timeout_s=timeout_s)
+            return
         if self._supervisor is not None:
             self._supervisor.join(timeout_s if timeout_s is not None
                                   else 5.0)
@@ -308,9 +504,12 @@ class ServingEngine:
                             for k, a in feed.items()))
 
     def _synthesize_example(self):
+        return self._synthesize_example_for(self._workers[0].predictor)
+
+    def _synthesize_example_for(self, predictor):
         """Build a 1-example feed from the program's var metadata (program
         path only — the StableHLO manifest doesn't carry shapes)."""
-        prog = getattr(self._workers[0].predictor, "_program", None)
+        prog = getattr(predictor, "_program", None)
         if prog is None or not self.feed_names:
             raise ValueError("warmup() needs example_feed for this "
                              "predictor type")

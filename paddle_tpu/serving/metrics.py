@@ -18,7 +18,17 @@ __all__ = ["ServingMetrics"]
 class ServingMetrics:
     def __init__(self, latency_window=8192):
         self.latency = Histogram(max_samples=latency_window)
+        # decode-tier tails (continuous batcher): time-to-first-token and
+        # time-per-output-token — THE serving-latency pair for
+        # autoregressive workloads (whole-request latency hides which of
+        # queueing vs generation is slow)
+        self.ttft = Histogram(max_samples=latency_window)
+        self.tpot = Histogram(max_samples=latency_window)
         self._lock = threading.Lock()
+        self._decode_steps = 0
+        self._decode_tokens = 0
+        self._slot_live = 0
+        self._slot_total = 0
         self._completed = 0
         self._failed = 0
         self._rejected = 0
@@ -81,6 +91,27 @@ class ServingMetrics:
         with self._lock:
             self._respawned += 1
 
+    def observe_decode_step(self, live, bucket, generated):
+        """One pass of the continuous-batching decode loop: ``live``
+        occupied slots out of ``bucket`` (the padded slot-table size),
+        ``generated`` tokens actually sampled this step (forced prompt
+        ingestion doesn't count)."""
+        with self._lock:
+            self._decode_steps += 1
+            self._decode_tokens += generated
+            self._slot_live += live
+            self._slot_total += bucket
+
+    def observe_ttft(self, latency_s):
+        """Admission -> first sampled token for one request."""
+        self.ttft.add(latency_s)
+
+    def observe_tpot(self, latency_s):
+        """Mean seconds per output token AFTER the first, for one
+        completed request (the steady-state generation rate its caller
+        saw, batching interference included)."""
+        self.tpot.add(latency_s)
+
     def observe_batch(self, actual, bucket, cache_hit):
         with self._lock:
             self._batches += 1
@@ -117,9 +148,16 @@ class ServingMetrics:
                 "compile_cache_misses": self._cache_misses,
                 "compile_cache_hit_rate": (self._cache_hits / lookups
                                            if lookups else None),
+                "decode_steps": self._decode_steps,
+                "decode_tokens": self._decode_tokens,
+                "slot_occupancy": (self._slot_live / self._slot_total
+                                   if self._slot_total else None),
             }
         lat = self.latency.percentiles((50, 95, 99))
         snap["latency_s"] = {k: lat[k] for k in ("p50", "p95", "p99")}
+        for name, hist in (("ttft_s", self.ttft), ("tpot_s", self.tpot)):
+            ps = hist.percentiles((50, 95, 99))
+            snap[name] = {k: ps[k] for k in ("p50", "p95", "p99")}
         return snap
 
     def report(self):
@@ -140,10 +178,13 @@ class ServingMetrics:
                     "replicas_evicted", "workers_respawned", "queue_depth",
                     "in_flight", "batches", "avg_batch_size",
                     "batch_occupancy", "compile_cache_hits",
-                    "compile_cache_misses", "compile_cache_hit_rate"):
+                    "compile_cache_misses", "compile_cache_hit_rate",
+                    "decode_steps", "decode_tokens", "slot_occupancy"):
             lines.append("%-32s %14s" % (key, fmt(s[key])))
-        for k, v in s["latency_s"].items():
-            lines.append("%-32s %14s" % (
-                "latency_%s_ms" % k,
-                "-" if v is None else "%.3f" % (v * 1e3)))
+        for group in ("latency_s", "ttft_s", "tpot_s"):
+            prefix = group[:-2]  # strip the _s unit suffix
+            for k, v in s[group].items():
+                lines.append("%-32s %14s" % (
+                    "%s_%s_ms" % (prefix, k),
+                    "-" if v is None else "%.3f" % (v * 1e3)))
         return "\n".join(lines)

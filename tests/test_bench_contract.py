@@ -33,23 +33,41 @@ def test_build_contract(monkeypatch):
 
 
 def test_serving_bench_record(monkeypatch):
-    """The serving config emits the same record shape as the BASELINE
-    configs and a finite p99-budget ratio (bench.py _bench_serving)."""
+    """The serving SLO harness emits the ISSUE 14 record shape: open-loop
+    Poisson arrival config, the rate sweep with shed/deadline counters,
+    and the decode-tier fields (ttft_p99 / tpot_p50 / slot_occupancy +
+    the continuous-vs-one-shot A/B)."""
     import bench
 
     monkeypatch.setenv("BENCH_SERVING_REQUESTS", "16")
-    monkeypatch.setenv("BENCH_SERVING_CLIENTS", "2")
+    monkeypatch.setenv("BENCH_SERVING_RATES", "150,300")
     monkeypatch.setenv("BENCH_SERVING_REPLICAS", "1")
+    monkeypatch.setenv("BENCH_DECODE_REQUESTS", "10")
     rec = bench._bench_serving(on_tpu=False)
     assert rec["metric"] == "serving_requests_per_sec"
     assert rec["unit"] == "requests/sec"
     assert rec["value"] > 0
     assert rec["vs_baseline"] > 0
     # self-describing record (ROADMAP item 5): the knobs that shaped the
-    # number ride in the line
-    assert rec["config"]["clients"] == 2
+    # number ride in the line — arrival process included
+    assert rec["config"]["arrival"] == "poisson-open-loop"
     assert rec["config"]["replicas"] == 1
     assert rec["config"]["p99_budget_s"] > 0
+    assert rec["config"]["requests_per_rate"] == 16
+    # the rate sweep: one row per rate with the overload counters
+    assert [r["rate"] for r in rec["rate_sweep"]] == [150.0, 300.0]
+    for row in rec["rate_sweep"]:
+        assert {"rate", "completed_rps", "p99_s", "rejected", "expired",
+                "met_slo"} <= set(row)
+    # decode-tier gauges (continuous batcher)
+    assert rec["ttft_p99"] is not None and rec["ttft_p99"] > 0
+    assert rec["tpot_p50"] is not None and rec["tpot_p50"] > 0
+    assert rec["slot_occupancy"] is not None
+    assert 0 < rec["slot_occupancy"] <= 1.0
+    dec = rec["decode"]
+    assert dec["requests"] == 10
+    assert dec["continuous_rps"] > 0 and dec["oneshot_rps"] > 0
+    assert dec["speedup"] > 0 and dec["tokens_per_sec"] > 0
     # reliability counters ride along and are all ZERO in a healthy run —
     # a nonzero means the number was earned under degradation
     rel = rec["reliability"]
