@@ -10,12 +10,17 @@ created it instead of a ``KeyError``/XLA trace error at execution time.
 Use it three ways:
 
   * ``fluid.Executor(...).run(program, ..., verify=True)`` or
-    ``PADDLE_TPU_VERIFY=1`` (``=warn`` downgrades errors to warnings) —
-    verification runs once per compiled program variant;
+    ``PADDLE_TPU_VERIFY=1`` (``=warn`` downgrades errors to warnings,
+    ``=strict`` additionally runs the resource lints) — verification
+    runs once per compiled program variant;
   * ``analysis.analyze_program(program, fetch_names=[...])`` for the
-    result object / report;
+    result object / report; ``analysis.cost.estimate_program`` for the
+    static roofline; ``analysis.spmd`` for sharding propagation and the
+    collective-sequence deadlock check; ``analysis.resources`` for the
+    VMEM-gate / recompile-hazard / compile-cache lints;
   * ``python -m paddle_tpu.analysis`` — CLI over the model zoo, saved
-    inference model dirs, and compiled-HLO sharding checks.
+    inference model dirs, compiled-HLO sharding checks, and the
+    ``--cost`` / ``--comm`` static performance passes.
 """
 
 from .dataflow import (  # noqa: F401
@@ -24,11 +29,24 @@ from .dataflow import (  # noqa: F401
 from .passes import (  # noqa: F401
     Diagnostic, AnalysisResult, VerificationError, ShapeCtx,
     analyze_program, verify_program, analyze_hlo_sharding, DEFAULT_CHECKS)
+from . import cost  # noqa: F401
+from . import resources  # noqa: F401
+from . import spmd  # noqa: F401
+from .cost import CostEstimate, estimate_program  # noqa: F401
+from .resources import RESOURCE_CHECKS, check_resources  # noqa: F401
+from .spmd import (  # noqa: F401
+    CollectiveEvent, analyze_jaxpr_collectives,
+    check_collective_consistency, collective_events, propagate_sharding)
 
 __all__ = [
     "OpNode", "Region", "build_region", "program_region",
     "effective_reads", "effective_writes", "SIDE_EFFECT_OPS",
     "Diagnostic", "AnalysisResult", "VerificationError", "ShapeCtx",
     "analyze_program", "verify_program", "analyze_hlo_sharding",
-    "DEFAULT_CHECKS",
+    "DEFAULT_CHECKS", "cost", "resources", "spmd",
+    "CostEstimate", "estimate_program",
+    "RESOURCE_CHECKS", "check_resources",
+    "CollectiveEvent", "analyze_jaxpr_collectives",
+    "check_collective_consistency", "collective_events",
+    "propagate_sharding",
 ]

@@ -1,0 +1,363 @@
+"""Static SPMD verifier over the Program IR (ISSUE 15).
+
+The trace-time checks in ``parallel/sharding_check.py`` only fire once a
+program has compiled to HLO (or traced to a jaxpr); this module makes
+the distribution properties STATIC program properties, the GSPMD-style
+propagation/consistency analog of the reference's multi-device graph
+passes (``multi_devices_graph_check_pass.cc``):
+
+  * :func:`propagate_sharding` — forward propagation of the parameter
+    ``sharding`` annotations (ParamAttr / DistributeTranspiler) through
+    the op list, with a **mismatch lint**: two inputs that shard the
+    same logical dimension over different mesh axes can only be
+    reconciled by a resharding all-gather GSPMD inserts silently — at
+    build time that is a finding with op provenance, not a surprise in
+    the profile.
+  * :func:`collective_events` — the program-level collective sequence:
+    every op that lowers to a named-axis collective (the id-routed /
+    psum sharded lookups, contraction-over-sharded-dim matmuls) in
+    program order, each with its **per-collective ICI volume estimate**
+    priced by the single comm model (``analysis.cost.comm_bytes_model``).
+  * :func:`check_collective_consistency` — SPMD programs that run in
+    lockstep across mesh processes must issue the SAME collective
+    sequence; a mismatched or reordered sequence is a deadlock at the
+    first diverging collective (every chip blocks in a different
+    collective, forever). Statically comparable, so statically checked.
+  * :func:`analyze_jaxpr_collectives` — the PR-6 jaxpr audit
+    (``collect_jaxpr_collectives`` + ``assert_no_full_output_psum``)
+    promoted to a real pass returning :class:`~.passes.Diagnostic`s.
+"""
+
+from .cost import CostCtx, comm_bytes_model
+from .passes import AnalysisResult, Diagnostic
+
+__all__ = ["CollectiveEvent", "collective_events", "propagate_sharding",
+           "check_collective_consistency", "analyze_jaxpr_collectives"]
+
+
+class CollectiveEvent:
+    """One collective a program op lowers to: kind ('all_to_all' /
+    'all_gather' / 'psum'), the mesh axis, the estimated per-step ICI
+    bytes, and the op it came from (provenance)."""
+
+    __slots__ = ("kind", "axis", "bytes", "op", "detail")
+
+    def __init__(self, kind, axis, nbytes, op, detail=""):
+        self.kind = kind
+        self.axis = axis
+        self.bytes = int(nbytes)
+        self.op = op
+        self.detail = detail
+
+    @property
+    def signature(self):
+        return (self.kind, self.axis)
+
+    def __repr__(self):
+        return "CollectiveEvent(%s@%s, %d B, op=%s)" % (
+            self.kind, self.axis, self.bytes,
+            self.op.type if self.op is not None else None)
+
+
+# ---------------------------------------------------------------------------
+# sharding propagation + mismatch lint
+# ---------------------------------------------------------------------------
+
+_UNARY_PRESERVE = frozenset({
+    "relu", "gelu", "tanh", "sigmoid", "softmax", "log_softmax", "scale",
+    "dropout", "cast", "clip", "exp", "log", "sqrt", "square", "abs",
+    "assign", "label_smooth", "increment", "leaky_relu", "elu", "swish",
+    "layer_norm", "group_norm", "batch_norm",
+})
+_ELEMENTWISE_BIN = frozenset({
+    "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "elementwise_max", "elementwise_min",
+    "elementwise_pow",
+})
+
+
+def _align_trailing(spec, rank):
+    """Pad/trim a spec to ``rank`` dims, aligned at the trailing dims
+    (numpy broadcast alignment)."""
+    if spec is None:
+        return None
+    spec = tuple(spec)
+    if len(spec) >= rank:
+        return spec[len(spec) - rank:]
+    return (None,) * (rank - len(spec)) + spec
+
+
+def _merge(a, b):
+    """Merge two aligned specs; returns (merged, conflict_dim|None)."""
+    if a is None:
+        return b, None
+    if b is None:
+        return a, None
+    out = []
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x is not None and y is not None and x != y:
+            return None, i
+        out.append(x if x is not None else y)
+    return tuple(out), None
+
+
+def propagate_sharding(program, mesh_axes=None, batch=None, esize=4,
+                       n_shards=None):
+    """Propagate the seeded parameter shardings through the op list.
+
+    Returns ``(specs, events, diagnostics)``: the final per-var specs,
+    the :class:`CollectiveEvent` list implied by contractions over
+    sharded dims (the row-parallel psum family), and the mismatch /
+    malformed-annotation findings. ``mesh_axes``, when given, also lints
+    annotations naming axes the mesh does not have."""
+    from .dataflow import program_region
+
+    diags = []
+    events = []
+    specs = {}
+    ctx = CostCtx(batch=batch or 1)
+    m = int(n_shards or 2)
+    for v in program.list_vars():
+        spec = getattr(v, "sharding", None)
+        if spec is None:
+            continue
+        shape = getattr(v, "shape", None)
+        if shape is not None and len(spec) != len(shape):
+            diags.append(Diagnostic(
+                "error", "sharding-annotation",
+                "var '%s' sharding spec %s has %d entries but the var is "
+                "rank %d" % (v.name, list(spec), len(spec), len(shape)),
+                var=v.name))
+            continue
+        if mesh_axes is not None:
+            for a in spec:
+                if a is not None and a not in mesh_axes:
+                    diags.append(Diagnostic(
+                        "error", "sharding-annotation",
+                        "var '%s' sharding spec %s names mesh axis %r "
+                        "which the mesh does not have (axes: %s)"
+                        % (v.name, list(spec), a, sorted(mesh_axes)),
+                        var=v.name))
+        specs[v.name] = tuple(spec)
+
+    def spec_of(var):
+        return None if var is None else specs.get(var.name)
+
+    def set_spec(var, spec):
+        if var is not None and spec is not None:
+            specs[var.name] = tuple(spec)
+
+    region = program_region(program)
+    for reg, node in region.walk():
+        op = node.op
+        if op.type in _UNARY_PRESERVE:
+            set_spec(op.output("Out") or op.output("Y"),
+                     spec_of(op.input("X")))
+            continue
+        if op.type in _ELEMENTWISE_BIN:
+            xv, yv = op.input("X"), op.input("Y")
+            ov = op.output("Out")
+            rank = len(getattr(ov, "shape", ()) or ())
+            xs = _align_trailing(spec_of(xv), rank)
+            ys = _align_trailing(spec_of(yv), rank)
+            merged, conflict = _merge(xs, ys)
+            if conflict is not None:
+                diags.append(Diagnostic(
+                    "error", "sharding-mismatch",
+                    "op '%s' combines '%s' (spec %s) with '%s' (spec %s): "
+                    "output dim %d is sharded over DIFFERENT mesh axes — "
+                    "GSPMD reconciles this with a silent resharding "
+                    "all-gather" % (op.type, xv.name, list(xs or ()),
+                                    yv.name, list(ys or ()), conflict),
+                    op=op, region=reg.name))
+                continue
+            set_spec(ov, merged)
+            continue
+        if op.type in ("mul", "matmul", "fused_linear_smooth_ce"):
+            xv = op.input("X")
+            yv = op.input("Y") or op.input("W")
+            ov = op.output("Out") or op.output("Loss")
+            xs, ys = spec_of(xv), spec_of(yv)
+            x_k = xs[-1] if xs else None
+            y_k = ys[0] if ys else None
+            if op.type == "matmul" and op.attr("transpose_Y", False) \
+                    and ys:
+                y_k = ys[-1]
+            if x_k is not None and y_k is not None and x_k != y_k:
+                diags.append(Diagnostic(
+                    "error", "sharding-mismatch",
+                    "op '%s' contracts '%s' (K sharded over %r) against "
+                    "'%s' (K sharded over %r) — mismatched contraction "
+                    "shardings force a resharding all-gather"
+                    % (op.type, xv.name, x_k, yv.name, y_k),
+                    op=op, region=reg.name))
+                continue
+            axis = x_k if x_k is not None else y_k
+            if axis is not None:
+                # contraction over a sharded dim: GSPMD completes the
+                # matmul with a psum of the output partials
+                n_out = ctx.nelems(ov)
+                vol = m * n_out * esize if n_out else 0
+                events.append(CollectiveEvent(
+                    "psum", axis, vol, op,
+                    detail="row-parallel contraction partials"))
+            if xs and ys and ov is not None:
+                out_rank = len(getattr(ov, "shape", ()) or ())
+                out_spec = tuple(xs[:-1])[:max(out_rank - 1, 0)] \
+                    + (ys[-1] if not (op.type == "matmul"
+                                      and op.attr("transpose_Y", False))
+                       else ys[0],)
+                if len(out_spec) == out_rank:
+                    set_spec(ov, out_spec)
+            continue
+        if op.type == "sharded_lookup_table":
+            events.extend(_lookup_events(ctx, op, m, esize))
+            # output rows are re-replicated by the lookup's all_gather
+            set_spec(op.output("Out"), None)
+            continue
+        # unknown op: outputs become unknown (no false positives)
+    return specs, events, diags
+
+
+def _lookup_events(ctx, op, m, esize):
+    """The collective sequence one sharded lookup issues, with volumes
+    from the single comm model (``cost.comm_bytes_model``)."""
+    from ..parallel.sharded_embedding import choose_strategy
+
+    axis = op.attr("mesh_axis", "mp")
+    ids = ctx.shape(op.input("Ids"))
+    ws = ctx.shape(op.input("W"))
+    if ids is None or ws is None or len(ws) != 2:
+        return []
+    if len(ids) >= 2 and ids[-1] == 1:
+        ids = ids[:-1]
+    n = 1
+    for d in ids:
+        n *= d
+    width = ws[1]
+    strategy = op.attr("emb_strategy") or choose_strategy(n, m, width)
+    model = comm_bytes_model(n, width, m, esize)
+    nd = n * width * esize
+    if strategy == "psum":
+        return [CollectiveEvent("psum", axis, model["psum_total_bytes"],
+                                op, detail="psum-of-partials lookup")]
+    return [
+        CollectiveEvent("all_to_all", axis, n * 4, op,
+                        detail="id packets"),
+        CollectiveEvent("all_to_all", axis, nd, op,
+                        detail="row payloads"),
+        CollectiveEvent("all_gather", axis,
+                        model["alltoall_total_bytes"] - n * 4 - nd, op,
+                        detail="output re-replication"),
+    ]
+
+
+def collective_events(program, n_shards=None, batch=None, esize=4,
+                      mesh_axes=None):
+    """The program's static collective sequence (see module docstring).
+    ``n_shards`` defaults to the program's attached mesh's ``mp`` size
+    when one exists (``DistributeTranspiler`` sets ``program._mesh``),
+    else 2."""
+    if n_shards is None:
+        mesh = getattr(program, "_mesh", None)
+        if mesh is not None:
+            n_shards = dict(zip(mesh.axis_names,
+                                mesh.devices.shape)).get("mp", 2)
+    _, events, _ = propagate_sharding(program, mesh_axes=mesh_axes,
+                                      batch=batch, esize=esize,
+                                      n_shards=n_shards)
+    return events
+
+
+# ---------------------------------------------------------------------------
+# cross-program collective-sequence consistency (static deadlock check)
+# ---------------------------------------------------------------------------
+
+def check_collective_consistency(sequences):
+    """``sequences``: {program label: [CollectiveEvent, ...]} for the
+    mesh programs meant to run in SPMD lockstep. Every program must
+    issue the identical (kind, axis) sequence — the first divergence is
+    where every chip would block in a DIFFERENT collective: a deadlock,
+    reported statically with both ops' provenance. Returns an
+    :class:`AnalysisResult`."""
+    diags = []
+    items = sorted(sequences.items())
+    if len(items) < 2:
+        return AnalysisResult(diags)
+    ref_label, ref = items[0]
+    for label, seq in items[1:]:
+        n = max(len(ref), len(seq))
+        for i in range(n):
+            a = ref[i] if i < len(ref) else None
+            b = seq[i] if i < len(seq) else None
+            if a is not None and b is not None \
+                    and a.signature == b.signature:
+                continue
+            if a is None:
+                diags.append(Diagnostic(
+                    "error", "collective-mismatch",
+                    "program '%s' issues collective #%d %s@%s (%s) but "
+                    "program '%s' has already finished its sequence — "
+                    "the extra collective blocks forever"
+                    % (label, i, b.kind, b.axis, b.detail, ref_label),
+                    op=b.op))
+            elif b is None:
+                diags.append(Diagnostic(
+                    "error", "collective-mismatch",
+                    "program '%s' issues collective #%d %s@%s (%s) but "
+                    "program '%s' has already finished its sequence — "
+                    "the extra collective blocks forever"
+                    % (ref_label, i, a.kind, a.axis, a.detail, label),
+                    op=a.op))
+            else:
+                diags.append(Diagnostic(
+                    "error", "collective-mismatch",
+                    "collective #%d diverges: program '%s' issues %s@%s "
+                    "(%s) while program '%s' issues %s@%s (%s) — in SPMD "
+                    "lockstep every chip blocks in a different "
+                    "collective: static deadlock"
+                    % (i, ref_label, a.kind, a.axis, a.detail, label,
+                       b.kind, b.axis, b.detail),
+                    op=b.op))
+            break  # report the FIRST divergence per pair — the deadlock
+    return AnalysisResult(diags)
+
+
+# ---------------------------------------------------------------------------
+# the PR-6 jaxpr audit, promoted to a pass
+# ---------------------------------------------------------------------------
+
+def analyze_jaxpr_collectives(jaxpr, forbid_full_output_psum_width=None,
+                              require=()):
+    """Run the trace-level collective audit as an analysis pass: the
+    collected collectives become the result's ``events`` attribute;
+    ``forbid_full_output_psum_width`` applies the ISSUE-13 rule (a psum
+    of any [*, width] tensor = the psum-of-partials lookup leaked onto
+    the routed path) as an error finding; ``require`` names primitives
+    that must be present (e.g. ``("all_to_all",)``)."""
+    from ..parallel import sharding_check as sc
+
+    colls = sc.collect_jaxpr_collectives(jaxpr)
+    diags = []
+    have = {name for name, _, _ in colls}
+    for prim in require or ():
+        if prim not in have:
+            diags.append(Diagnostic(
+                "error", "collective-missing",
+                "expected a %r collective in the traced step, found %s"
+                % (prim, sorted(have) or "none")))
+    if forbid_full_output_psum_width is not None:
+        w = int(forbid_full_output_psum_width)
+        bad = [(name, axes, s) for name, axes, shapes in colls
+               if name == "psum"
+               for s in shapes if len(s) >= 2 and s[-1] == w]
+        if bad:
+            diags.append(Diagnostic(
+                "error", "collective-psum",
+                "step psums full [n, %d] lookup outputs %s — the "
+                "psum-of-partials formulation leaked onto the "
+                "all-to-all path (O(mp*n*D) redundant ICI volume; "
+                "parallel/sharded_embedding.py)" % (w, bad)))
+    result = AnalysisResult(diags)
+    result.events = colls
+    return result

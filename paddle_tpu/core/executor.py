@@ -287,7 +287,11 @@ class Executor:
         is donated — the fetch/donation alias check. Errors raise
         :class:`analysis.VerificationError` naming the op and the user
         line that created it; ``verify="warn"`` (or
-        ``PADDLE_TPU_VERIFY=warn``) downgrades errors to warnings."""
+        ``PADDLE_TPU_VERIFY=warn``) downgrades errors to warnings;
+        ``verify="strict"`` (or ``PADDLE_TPU_VERIFY=strict``) additionally
+        runs the RESOURCE lints (``analysis.resources``: Pallas VMEM-gate
+        refusals, dynamic-shape recompile hazards) — advisory findings
+        surfaced as warnings, correctness errors still raising."""
         from .compiler import CompiledProgram
 
         if program is None:
@@ -413,12 +417,15 @@ class Executor:
         entry = self._cache.get(key) if use_program_cache else None
         if verify is None:
             mode = os.environ.get("PADDLE_TPU_VERIFY", "").strip().lower()
-            verify = "warn" if mode == "warn" else mode in (
-                "1", "true", "yes", "on", "raise")
+            if mode in ("warn", "strict"):
+                verify = mode
+            else:
+                verify = mode in ("1", "true", "yes", "on", "raise")
         # once per program variant AT this strictness, cache hit or not —
         # an explicit verify=True after the variant compiled (or after a
         # warn-mode pass) must still verify
-        strictness = 0 if not verify else (1 if verify == "warn" else 2)
+        strictness = 0 if not verify else {
+            "warn": 1, "strict": 3}.get(verify, 2)
         if strictness > self._verified.get(key, 0):
             from ..analysis import verify_program
 
@@ -426,6 +433,16 @@ class Executor:
                 program, feed_names=sorted(feed_arrays),
                 fetch_names=fetch_names, state_names=persist_names,
                 donate_state=donate_state, warn=(verify == "warn"))
+            if strictness >= 3:
+                from ..analysis.resources import check_resources
+
+                batch = None
+                for a in feed_arrays.values():
+                    if getattr(a, "ndim", 0) >= 1:
+                        batch = int(a.shape[0])
+                        break
+                for d in check_resources(program, batch=batch).diagnostics:
+                    warnings.warn("program verification: %s" % d)
             self._verified[key] = strictness
         if entry is None:
             entry = self._compile(program, tuple(sorted(feed_arrays)),

@@ -79,6 +79,56 @@ def shape_rule(name):
     return SHAPE_RULES.get(name)
 
 
+# ---------------------------------------------------------------------------
+# Static cost rules (the roofline analog of the shape rules — ISSUE 15).
+# Each rule is ``rule(ctx, op)`` over an ``analysis.cost.CostCtx``: read
+# input shapes via ``ctx.shape`` / element sizes via ``ctx.esize`` and
+# charge the op via ``ctx.add(op, flops=..., hbm_bytes=..., bwd_flops=...,
+# bwd_hbm_bytes=..., row_reads=..., bwd_row_writes=...)``. The convention
+# is a FLOOR model (minimum achievable traffic under ideal XLA fusion) —
+# the same stance the committed per-bucket rooflines take, so the engine
+# IS the single bytes model behind bench.py --attribute,
+# tools/attribute_resnet.floors and the DeepFM comm line. Rules live in
+# ``core/opimpl/cost_rules.py``; an op without a rule contributes zero and
+# is reported in the estimate's ``uncosted`` list (honesty over silence).
+# ---------------------------------------------------------------------------
+
+COST_RULES = {}
+
+
+def register_cost(*names):
+    """Decorator: register a static cost rule for op type(s)."""
+
+    def deco(fn):
+        for n in names:
+            if n in COST_RULES:
+                raise ValueError("cost rule for %s registered twice" % n)
+            COST_RULES[n] = fn
+        return fn
+
+    return deco
+
+
+def register_zero_cost(*names):
+    """Explicit zero-cost registration: the op folds away under fusion
+    (views, scalar bookkeeping, trace-time constants). Distinct from
+    *missing* a rule — the registry-parity test accepts these, the
+    estimate does not report them as uncosted."""
+
+    def _zero(ctx, op):
+        ctx.add(op)
+
+    for n in names:
+        if n in COST_RULES:
+            raise ValueError("cost rule for %s registered twice" % n)
+        COST_RULES[n] = _zero
+    return _zero
+
+
+def cost_rule(name):
+    return COST_RULES.get(name)
+
+
 def env_flag(name):
     """gflags-style boolean env: '1'/'true'/'yes'/'on' (any case) = on."""
     import os

@@ -18,3 +18,4 @@ from . import loss_ops  # noqa: F401
 from . import quant_ops  # noqa: F401
 from . import misc_ops  # noqa: F401
 from . import shape_rules  # noqa: F401  (static InferShape rules)
+from . import cost_rules  # noqa: F401  (static roofline cost rules)

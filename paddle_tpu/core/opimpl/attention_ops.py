@@ -12,7 +12,7 @@ from ..op_registry import register, get, put, next_rng
 
 @register("flash_attention")
 def _flash_attention_op(env, op):
-    from ...ops.flash_attention import flash_attention
+    from ...ops.flash_attention import flash_attention, plan_for
 
     from ..op_registry import mxu_cast
 
@@ -24,9 +24,14 @@ def _flash_attention_op(env, op):
     q, k, v = mxu_cast(q, k, v)
     dropout = op.attr("dropout_rate", 0.0)
     rng = next_rng(env) if dropout > 0.0 else None
+    plan = plan_for(q, k, bias, op.attr("num_heads", 1),
+                    op.attr("causal", False), dropout, rng)
+    # trace-time record: which attention kernel this op actually takes
+    # and why a demotion happened (ISSUE 15 no-silent-fallback contract)
+    op.attrs["_kernel_choice"] = plan.to_dict()
     out = flash_attention(q, k, v, op.attr("num_heads", 1), bias=bias,
                           causal=op.attr("causal", False),
-                          dropout_rate=dropout, rng=rng)
+                          dropout_rate=dropout, rng=rng, plan=plan)
     put(env, op.output("Out"), out.astype(out_dtype))
 
 

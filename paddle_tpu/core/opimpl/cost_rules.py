@@ -1,0 +1,462 @@
+"""Static per-op cost rules (FLOPs + HBM bytes + row ops) — ISSUE 15.
+
+Registered via :func:`core.op_registry.register_cost`, beside the shape
+rules; consumed by ``analysis/cost.py``'s :func:`estimate_program`. The
+registry-parity test (``tests/test_cost_engine.py``) holds every op with
+a shape rule to having a cost rule (or an explicit zero-cost
+registration), so a new op cannot silently fall out of the roofline.
+
+Modeling convention — the FLOOR stance of the committed per-bucket
+rooflines (``tools/attribute_resnet.py`` pre-refactor, now delegated
+here; ``RESNET_ROOFLINE.json``'s note):
+
+  * elementwise/activation/reduction/cast ops ride a producer's fusion
+    epilogue: zero extra HBM traffic, FLOPs counted;
+  * irreducible passes charge bytes: matmul/conv operand streams,
+    same-shape residual merges (2R+1W of a distant tensor), transposes
+    (a real relayout), max-pool select-and-scatter, optimizer state
+    passes (master precision, f32);
+  * conv backward carries the BN/relu riders the fused lowering pays:
+    one extra full activation pass on each of the dX and dW fusions
+    (relu mask + BN x-hat reads ride dX, dgamma/dbeta reduction reads
+    ride dW) — batch_norm itself then charges zero, exactly the
+    committed accounting;
+  * embedding lookups / scatter-adds charge ROWS, not bytes (TPU row
+    ops are latency-bound — ``ROW_OP_FLOORS.json``); the roofline adds
+    the row term on top of max(compute, HBM).
+
+Backward columns (``bwd_*``) are charged only for ops an ``autodiff``
+op actually replays — the engine handles that; rules just fill both.
+"""
+
+from ..op_registry import register_cost, register_zero_cost
+from .shape_rules import _COMPARE, _ELEMENTWISE, _LIKE_X, _LOGICAL
+
+
+def _prod(dims):
+    n = 1
+    for d in dims:
+        n *= int(d)
+    return n
+
+
+def _nel(ctx, var):
+    return None if var is None else ctx.nelems(var)
+
+
+# ---------------------------------------------------------------------------
+# elementwise / unary / reductions: FLOPs only (epilogue-fused floor),
+# except same-shape >=3-D merges (residual adds) which read a distant
+# tensor: 2 reads + 1 write, the committed residual accounting
+# ---------------------------------------------------------------------------
+
+def _elementwise_cost(ctx, op):
+    out = op.output("Out")
+    n = _nel(ctx, out)
+    if n is None:
+        ctx.add(op, unresolved=True)
+        return
+    xs = ctx.shape(op.input("X"))
+    ys = ctx.shape(op.input("Y"))
+    merge = (xs is not None and ys is not None and xs == ys
+             and len(xs) >= 3)
+    ctx.add(op, flops=n, bwd_flops=n,
+            hbm_bytes=3 * n * ctx.esize(out) if merge else 0,
+            note="residual merge: 2R+1W" if merge else None)
+
+
+for _n in _ELEMENTWISE:
+    register_cost(_n)(_elementwise_cost)
+
+
+def _flops_like_out(ctx, op, slot="Out", per_elem=1):
+    v = op.output(slot) or op.output("Y")
+    n = _nel(ctx, v)
+    if n is None:
+        ctx.add(op, unresolved=True)
+        return
+    ctx.add(op, flops=per_elem * n, bwd_flops=per_elem * n)
+
+
+for _n in _COMPARE + _LOGICAL + ("logical_not",):
+    register_cost(_n)(_flops_like_out)
+
+for _n in _LIKE_X:
+    register_cost(_n)(_flops_like_out)
+
+register_cost("dropout")(_flops_like_out)
+
+
+def _reduce_cost(ctx, op):
+    n = _nel(ctx, op.input("X"))
+    if n is None:
+        ctx.add(op, unresolved=True)
+        return
+    ctx.add(op, flops=n, bwd_flops=n)
+
+
+for _n in ("reduce_sum", "reduce_mean", "reduce_max", "reduce_min",
+           "reduce_prod", "mean"):
+    register_cost(_n)(_reduce_cost)
+
+
+@register_cost("sum")
+def _sum_cost(ctx, op):
+    vs = op.input_list("X")
+    ns = [_nel(ctx, v) for v in vs]
+    if any(n is None for n in ns):
+        ctx.add(op, unresolved=True)
+        return
+    ctx.add(op, flops=sum(ns), bwd_flops=sum(ns))
+
+
+# views / scalar bookkeeping / trace-time constants: fold away
+register_zero_cost(
+    "cast", "reshape", "reshape2", "squeeze", "squeeze2", "unsqueeze",
+    "unsqueeze2", "flatten", "flatten2", "concat", "split", "stack",
+    "slice", "expand", "shape", "fill_constant", "uniform_random",
+    "gaussian_random", "truncated_gaussian_random",
+    "fill_constant_batch_size_like", "uniform_random_batch_size_like",
+    "gaussian_random_batch_size_like", "accuracy")
+
+
+@register_cost("transpose", "transpose2")
+def _transpose_cost(ctx, op):
+    # a real relayout: read + write, both directions (the seq-2048
+    # head-split copies were exactly this bucket)
+    n = _nel(ctx, op.input("X"))
+    if n is None:
+        ctx.add(op, unresolved=True)
+        return
+    e = ctx.esize(op.input("X"))
+    ctx.add(op, hbm_bytes=2 * n * e, bwd_hbm_bytes=2 * n * e)
+
+
+# ---------------------------------------------------------------------------
+# matmul family: 2MNK forward, 4MNK backward (dX + dW); operand streams
+# ---------------------------------------------------------------------------
+
+@register_cost("mul")
+def _mul_cost(ctx, op):
+    xv, yv = op.input("X"), op.input("Y")
+    xs, ys = ctx.shape(xv), ctx.shape(yv)
+    if xs is None or ys is None:
+        ctx.add(op, unresolved=True)
+        return
+    xnc = op.attr("x_num_col_dims", 1)
+    ync = op.attr("y_num_col_dims", 1)
+    m, k = _prod(xs[:xnc]), _prod(xs[xnc:])
+    n2 = _prod(ys[ync:])
+    f = 2.0 * m * k * n2
+    e = ctx.esize(xv)
+    streams = (m * k + k * n2 + m * n2) * e
+    ctx.add(op, flops=f, hbm_bytes=streams,
+            bwd_flops=2 * f, bwd_hbm_bytes=2 * streams)
+
+
+@register_cost("matmul")
+def _matmul_cost(ctx, op):
+    xv, yv = op.input("X"), op.input("Y")
+    xs, ys = ctx.shape(xv), ctx.shape(yv)
+    if xs is None or ys is None or len(xs) < 2 or len(ys) < 2:
+        ctx.add(op, unresolved=True)
+        return
+    if op.attr("transpose_X", False):
+        xs = xs[:-2] + (xs[-1], xs[-2])
+    if op.attr("transpose_Y", False):
+        ys = ys[:-2] + (ys[-1], ys[-2])
+    batch = max(_prod(xs[:-2]), _prod(ys[:-2]))
+    m, k, n2 = xs[-2], xs[-1], ys[-1]
+    f = 2.0 * batch * m * k * n2
+    e = ctx.esize(xv)
+    streams = (_prod(xs) + _prod(ys)
+               + batch * m * n2) * e
+    ctx.add(op, flops=f, hbm_bytes=streams,
+            bwd_flops=2 * f, bwd_hbm_bytes=2 * streams)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool / norm: the committed resnet bytes model, per-op
+# ---------------------------------------------------------------------------
+
+@register_cost("conv2d", "depthwise_conv2d")
+def _conv2d_cost(ctx, op):
+    xv, wv = op.input("Input"), op.input("Filter")
+    ov = op.output("Output")
+    xs, ws, os_ = ctx.shape(xv), ctx.shape(wv), ctx.shape(ov)
+    if xs is None or ws is None or os_ is None or len(xs) != 4 \
+            or len(ws) != 4 or len(os_) != 4:
+        ctx.add(op, unresolved=True)
+        return
+    n, c, h, w_ = xs
+    o, _, kh, kw = ws
+    _, _, oh, ow = os_
+    f = 2.0 * n * o * oh * ow * c * kh * kw
+    e = ctx.esize(xv)
+    xb = n * c * h * w_ * e
+    yb = n * o * oh * ow * e
+    wb = o * c * kh * kw * e
+    # images carry no gradient: a conv fed straight from a data var has
+    # no dX (XLA DCEs it) — the stem-conv exclusion, per-op
+    has_dx = not getattr(xv, "is_data", False)
+    stride2 = h > oh  # resnet uses stride only to halve resolution
+    # dX of a stride-2 conv lowers as lhs_dilated (zero-stuffed) conv on
+    # the MXU: 4x the MAC grid — a lowering property, so it is floor
+    dx_f = f * (4 if stride2 else 1) if has_dx else 0.0
+    dx_b = (yb + wb + xb) if has_dx else 0
+    dw_b = xb + yb + o * c * kh * kw * 4  # f32 dW
+    # BN/relu ride the conv fusions: one extra full activation pass on
+    # each of dX (relu mask + BN x-hat) and dW (dgamma/dbeta reads).
+    # The note carries the dx/dw split so the per-bucket attribution
+    # (tools/attribute_resnet.py) can rebuild its buckets from THESE
+    # numbers instead of a second model.
+    ctx.add(op, flops=f, hbm_bytes=xb + wb + yb,
+            bwd_flops=f + dx_f, bwd_hbm_bytes=dx_b + dw_b + 2 * yb,
+            note={"kind": "conv", "dx_flops": dx_f, "dx_bytes": dx_b,
+                  "dw_flops": f, "dw_bytes": dw_b, "ride_bytes": 2 * yb,
+                  "fwd_1x": f if has_dx else 0.0})
+
+
+@register_cost("fused_conv2d")
+def _fused_conv2d_cost(ctx, op):
+    """The epilogue-fused chain: conv streams + one residual read when
+    present; BN stats ride the output pass (that is the point of the
+    fusion), backward identical to the unfused conv's accounting."""
+    xv, wv = op.input("Input"), op.input("Filter")
+    ov = op.output("Y")
+    xs, ws, os_ = ctx.shape(xv), ctx.shape(wv), ctx.shape(ov)
+    if xs is None or ws is None or os_ is None or len(xs) != 4 \
+            or len(ws) != 4:
+        ctx.add(op, unresolved=True)
+        return
+    n, c, h, w_ = xs
+    o, _, kh, kw = ws
+    oh, ow = os_[2], os_[3]
+    f = 2.0 * n * o * oh * ow * c * kh * kw
+    e = ctx.esize(xv)
+    xb = n * c * h * w_ * e
+    yb = n * o * oh * ow * e
+    wb = o * c * kh * kw * e
+    rb = yb if op.input("Residual") is not None else 0
+    has_dx = not getattr(xv, "is_data", False)
+    stride2 = h > oh
+    dx_f = f * (4 if stride2 else 1) if has_dx else 0.0
+    dx_b = (yb + wb + xb) if has_dx else 0
+    dw_b = xb + yb + o * c * kh * kw * 4
+    ctx.add(op, flops=f, hbm_bytes=xb + wb + yb + rb,
+            bwd_flops=f + dx_f, bwd_hbm_bytes=dx_b + dw_b + 2 * yb)
+
+
+@register_cost("pool2d")
+def _pool2d_cost(ctx, op):
+    xb_n = _nel(ctx, op.input("X"))
+    ob_n = _nel(ctx, op.output("Out"))
+    if xb_n is None or ob_n is None:
+        ctx.add(op, unresolved=True)
+        return
+    e = ctx.esize(op.input("X"))
+    xb, ob = xb_n * e, ob_n * e
+    if op.attr("pooling_type", "max") == "max":
+        # fwd read+write; bwd select-and-scatter reads x, dy, writes dx
+        ctx.add(op, hbm_bytes=xb + ob, bwd_hbm_bytes=xb + 2 * ob)
+    else:
+        ctx.add(op, hbm_bytes=xb + ob, bwd_hbm_bytes=xb + ob)
+
+
+@register_cost("batch_norm")
+def _batch_norm_cost(ctx, op):
+    # rides the conv fusions in this lowering (measured standalone BN
+    # ~0.6 ms = fused): fwd stats/scale/shift fuse into the conv output
+    # pass; the backward's activation re-reads are charged on the conv
+    # rule's dX/dW riders — charging them here too would double-count
+    n = _nel(ctx, op.input("X"))
+    ctx.add(op, flops=2 * (n or 0), bwd_flops=2 * (n or 0),
+            note="bytes ride the conv epilogue fusions")
+
+
+@register_cost("layer_norm", "group_norm")
+def _layer_norm_cost(ctx, op):
+    # a two-pass statistic op XLA cannot fully fuse away: read + write
+    # forward, one extra activation read backward (x-hat)
+    n = _nel(ctx, op.input("X"))
+    if n is None:
+        ctx.add(op, unresolved=True)
+        return
+    e = ctx.esize(op.input("X"))
+    ctx.add(op, flops=8 * n, hbm_bytes=2 * n * e,
+            bwd_flops=8 * n, bwd_hbm_bytes=3 * n * e)
+
+
+# ---------------------------------------------------------------------------
+# embedding / indexing: ROW ops (latency-bound, priced per-row)
+# ---------------------------------------------------------------------------
+
+def _lookup_cost(ctx, op):
+    ids = ctx.shape(op.input("Ids"))
+    if ids is None:
+        ctx.add(op, unresolved=True)
+        return
+    if len(ids) >= 2 and ids[-1] == 1:
+        ids = ids[:-1]  # LoD-era trailing [.., 1] squeeze
+    n = _prod(ids)
+    # fwd: n gathered rows; bwd: the densify / sharded backward is one
+    # scatter-add of the same n rows
+    ctx.add(op, row_reads=n, bwd_row_writes=n)
+
+
+register_cost("lookup_table", "sharded_lookup_table")(_lookup_cost)
+
+
+@register_cost("gather")
+def _gather_cost(ctx, op):
+    idx = ctx.shape(op.input("Index"))
+    if idx is None:
+        ctx.add(op, unresolved=True)
+        return
+    n = _prod(idx)
+    ctx.add(op, row_reads=n, bwd_row_writes=n)
+
+
+@register_cost("scatter")
+def _scatter_cost(ctx, op):
+    idx = ctx.shape(op.input("Ids"))
+    if idx is None:
+        ctx.add(op, unresolved=True)
+        return
+    n = _prod(idx)
+    ctx.add(op, row_writes=n, bwd_row_reads=n)
+
+
+@register_cost("one_hot")
+def _one_hot_cost(ctx, op):
+    n = _nel(ctx, op.output("Out"))
+    ctx.add(op, flops=n or 0)
+
+
+# ---------------------------------------------------------------------------
+# losses / metrics / search
+# ---------------------------------------------------------------------------
+
+def _loss_cost(per_elem):
+    def rule(ctx, op):
+        v = op.input("X") or op.input("Logits")
+        n = _nel(ctx, v)
+        if n is None:
+            ctx.add(op, unresolved=True)
+            return
+        ctx.add(op, flops=per_elem * n, bwd_flops=per_elem * n)
+    return rule
+
+
+register_cost("cross_entropy")(_loss_cost(3))
+register_cost("softmax_with_cross_entropy",
+              "smooth_softmax_with_cross_entropy")(_loss_cost(5))
+
+
+@register_cost("fused_linear_smooth_ce")
+def _fused_ce_cost(ctx, op):
+    # vocab projection + smoothed CE in one op: the matmul dominates
+    xs, ws = ctx.shape(op.input("X")), ctx.shape(op.input("W"))
+    if xs is None or ws is None or len(ws) != 2:
+        ctx.add(op, unresolved=True)
+        return
+    rows = _prod(xs[:-1])
+    d, v = ws
+    f = 2.0 * rows * d * v
+    e = ctx.esize(op.input("X"))
+    streams = (rows * d + d * v + rows) * e  # logits stay in VMEM
+    ctx.add(op, flops=f, hbm_bytes=streams,
+            bwd_flops=2 * f, bwd_hbm_bytes=2 * streams)
+
+
+@register_cost("pow")
+def _pow_cost(ctx, op):
+    n = _nel(ctx, op.input("X"))
+    ctx.add(op, flops=n or 0, bwd_flops=n or 0)
+
+
+register_zero_cost("range", "sequence_mask")
+
+
+@register_cost("top_k")
+def _top_k_cost(ctx, op):
+    n = _nel(ctx, op.input("X"))
+    ctx.add(op, flops=2 * (n or 0))
+
+
+@register_cost("argmax", "argmin")
+def _arg_cost(ctx, op):
+    n = _nel(ctx, op.input("X"))
+    ctx.add(op, flops=n or 0)
+
+
+# ---------------------------------------------------------------------------
+# attention (the Pallas kernel family) + incremental decode
+# ---------------------------------------------------------------------------
+
+@register_cost("flash_attention")
+def _flash_attention_cost(ctx, op):
+    qs = ctx.shape(op.input("Q"))
+    ks = ctx.shape(op.input("K"))
+    if qs is None or ks is None or len(qs) != 3 or len(ks) != 3:
+        ctx.add(op, unresolved=True)
+        return
+    b, t, hd = qs
+    t_k = ks[1]
+    e = ctx.esize(op.input("Q"))
+    f = 4.0 * b * t * t_k * hd  # QK^T + AV
+    # streaming kernels: q/k/v read + o write forward; backward re-reads
+    # the streams and writes dq/dk/dv (flash recompute keeps logits out
+    # of HBM — that is the kernel's point)
+    fwd_b = (2 * b * t * hd + 2 * b * t_k * hd) * e
+    ctx.add(op, flops=f, hbm_bytes=fwd_b,
+            bwd_flops=2.5 * f, bwd_hbm_bytes=2 * fwd_b)
+
+
+@register_cost("kv_cache_write")
+def _kv_cache_write_cost(ctx, op):
+    n = _nel(ctx, op.input("X"))
+    if n is None:
+        ctx.add(op, unresolved=True)
+        return
+    e = ctx.esize(op.input("X"))
+    ctx.add(op, hbm_bytes=2 * n * e)  # read token slice, write rows
+
+
+@register_cost("cached_attention")
+def _cached_attention_cost(ctx, op):
+    ks = ctx.shape(op.input("CacheK"))
+    qs = ctx.shape(op.input("Q"))
+    if ks is None or qs is None or len(ks) != 3:
+        ctx.add(op, unresolved=True)
+        return
+    b, cap, hd = ks
+    e = ctx.esize(op.input("Q"))
+    ctx.add(op, flops=4.0 * b * cap * hd,
+            hbm_bytes=(2 * b * cap * hd + 2 * b * hd) * e)
+
+
+# ---------------------------------------------------------------------------
+# optimizer updates: master-precision (f32) state passes, batch-amortized
+# ---------------------------------------------------------------------------
+
+def _opt_cost(state_passes):
+    """read+write of param + each optimizer-state slot, f32 master
+    precision (the committed adam accounting: 6 passes)."""
+
+    def rule(ctx, op):
+        p = op.input("Param") or op.input("ParamOut")
+        n = _nel(ctx, p)
+        if n is None:
+            ctx.add(op, unresolved=True)
+            return
+        ctx.add(op, hbm_bytes=state_passes * n * 4)
+    return rule
+
+
+register_cost("sgd")(_opt_cost(2))                    # p rw
+register_cost("momentum", "adagrad", "sparse_decay")(_opt_cost(4))
+register_cost("lars_momentum")(_opt_cost(4))
+register_cost("adam", "adamax", "adadelta", "rmsprop",
+              "decayed_adagrad", "lamb")(_opt_cost(6))  # p/m/v rw
+register_cost("ftrl")(_opt_cost(6))

@@ -287,9 +287,13 @@ def _fused_conv2d(env, op):
     res_var = op.input("Residual")
     residual = get(env, res_var) if res_var is not None else None
 
-    if not fused_conv.use_pallas(x.shape, w.shape, strides, pads, dil,
-                                 groups, x.dtype.itemsize,
-                                 residual is not None):
+    decision = fused_conv.gate(x.shape, w.shape, strides, pads, dil,
+                               groups, x.dtype.itemsize,
+                               residual is not None)
+    # trace-time record: which kernel this op actually takes, and why a
+    # refusal fell back (the ISSUE 15 no-silent-fallback contract)
+    op.attrs["_kernel_choice"] = decision.to_dict()
+    if not decision:
         for sub in op.attr("orig_ops") or ():
             if is_test and not sub.attr("is_test", False) \
                     and sub.type in ("batch_norm", "dropout"):

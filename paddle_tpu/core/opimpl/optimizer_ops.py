@@ -39,16 +39,20 @@ def _sparse_grad(env, op):
 _merge_rows = merge_sparse_rows
 
 
-def _densify(g, rows, shape):
+def _densify(g, rows, shape, op=None):
     """Densify a (rows, values) sparse grad for optimizers whose update
     runs over every row (non-lazy adam — the DeepFM bench path — and the
     optimizers without a dedicated sparse kernel). Routed through the
     Pallas VMEM-resident scatter-add (``ops/scatter.py``) when the table
     qualifies; XLA's ``.at[].add`` otherwise. Exact either way
-    (out-of-range sentinel rows drop, duplicates accumulate)."""
+    (out-of-range sentinel rows drop, duplicates accumulate). The gate's
+    structured decision is recorded in ``op``'s attrs
+    (``_kernel_choice``) so the chosen kernel — and any refusal's reason
+    — is inspectable on the built program."""
     if len(shape) == 2 and g.ndim == 2:
-        from ...ops.scatter import scatter_add_rows
+        from ...ops.scatter import record_choice, scatter_add_rows
 
+        record_choice(op, shape[0], shape[1], g.shape[0], g.dtype)
         return scatter_add_rows(jnp.zeros(shape, g.dtype), rows, g)
     return jnp.zeros(shape, g.dtype).at[rows].add(g, mode="drop")
 
@@ -79,8 +83,10 @@ def _sgd(env, op):
         # (Pallas row-scatter when the table qualifies — ops/scatter.py)
         upd = -_lr(env, op) * g
         if p.ndim == 2 and upd.ndim == 2:
-            from ...ops.scatter import scatter_add_rows
+            from ...ops.scatter import record_choice, scatter_add_rows
 
+            record_choice(op, p.shape[0], p.shape[1], upd.shape[0],
+                          p.dtype)
             put(env, op.output("ParamOut"), scatter_add_rows(p, rows, upd))
         else:
             put(env, op.output("ParamOut"),
@@ -123,7 +129,7 @@ def _lars_momentum(env, op):
     p = get(env, op.input("Param"))
     g, _rows = _sparse_grad(env, op)
     if _rows is not None:
-        g = _densify(g, _rows, p.shape)
+        g = _densify(g, _rows, p.shape, op)
     v = get(env, op.input("Velocity"))
     mu = op.attr("mu")
     lars_coeff = op.attr("lars_coeff", 0.001)
@@ -163,7 +169,7 @@ def _adam(env, op):
         # one scatter-add (~15 ns/row) replaces the lazy branch's 3 row
         # gathers + 3 row scatters (measured 45 -> ~12 ms/step on the
         # DeepFM bench, tools/bench_gather.py has the per-op rates).
-        g = _densify(g.astype(p.dtype), rows, p.shape)
+        g = _densify(g.astype(p.dtype), rows, p.shape, op)
         rows = None
     if rows is not None:
         # ref adam_op.h SparseAdamFunctor (lazy_mode=true): only touched
@@ -196,7 +202,7 @@ def _adamax(env, op):
     p = get(env, op.input("Param"))
     g, _rows = _sparse_grad(env, op)
     if _rows is not None:
-        g = _densify(g, _rows, p.shape)
+        g = _densify(g, _rows, p.shape, op)
     m = get(env, op.input("Moment"))
     inf_norm = get(env, op.input("InfNorm"))
     b1p = get(env, op.input("Beta1Pow")).reshape(())
@@ -239,7 +245,7 @@ def _decayed_adagrad(env, op):
     p = get(env, op.input("Param"))
     g, _rows = _sparse_grad(env, op)
     if _rows is not None:
-        g = _densify(g, _rows, p.shape)
+        g = _densify(g, _rows, p.shape, op)
     mom = get(env, op.input("Moment"))
     decay = op.attr("decay", 0.95)
     eps = op.attr("epsilon", 1e-6)
@@ -254,7 +260,7 @@ def _adadelta(env, op):
     p = get(env, op.input("Param"))
     g, _rows = _sparse_grad(env, op)
     if _rows is not None:
-        g = _densify(g, _rows, p.shape)
+        g = _densify(g, _rows, p.shape, op)
     avg_sq_g = get(env, op.input("AvgSquaredGrad"))
     avg_sq_u = get(env, op.input("AvgSquaredUpdate"))
     rho = op.attr("rho", 0.95)
@@ -272,7 +278,7 @@ def _rmsprop(env, op):
     p = get(env, op.input("Param"))
     g, _rows = _sparse_grad(env, op)
     if _rows is not None:
-        g = _densify(g, _rows, p.shape)
+        g = _densify(g, _rows, p.shape, op)
     ms = get(env, op.input("MeanSquare"))
     mg = get(env, op.input("MeanGrad"))
     mom = get(env, op.input("Moment"))
@@ -299,7 +305,7 @@ def _ftrl(env, op):
     p = get(env, op.input("Param"))
     g, _rows = _sparse_grad(env, op)
     if _rows is not None:
-        g = _densify(g, _rows, p.shape)
+        g = _densify(g, _rows, p.shape, op)
     sq = get(env, op.input("SquaredAccumulator"))
     lin = get(env, op.input("LinearAccumulator"))
     l1 = op.attr("l1", 0.0)
@@ -330,7 +336,7 @@ def _lamb(env, op):
     p = get(env, op.input("Param"))
     g, _rows = _sparse_grad(env, op)
     if _rows is not None:
-        g = _densify(g, _rows, p.shape)
+        g = _densify(g, _rows, p.shape, op)
     m = get(env, op.input("Moment1"))
     v = get(env, op.input("Moment2"))
     b1p = get(env, op.input("Beta1Pow")).reshape(())

@@ -63,25 +63,14 @@ _SCATTER_NS_PER_ROW = 15.0
 def row_op_floors(path=None):
     """(gather_ns, scatter_ns, source): the measured per-row latencies
     from ``ROW_OP_FLOORS.json`` beside bench.py, falling back to the
-    round-5 constants above (source then says so)."""
-    import json
-    import os
+    round-5 constants above (source then says so). DELEGATES to the
+    single reader in ``analysis.cost`` (ISSUE 15), so this floor and
+    the static roofline can never read different constants."""
+    from ..analysis.cost import row_op_floors as reader
 
-    if path is None:
-        path = os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))),
-            "ROW_OP_FLOORS.json")
-    try:
-        with open(path) as f:
-            rec = json.load(f)
-        if isinstance(rec, dict):
-            gather = rec.get("gather_ns_per_row")
-            scatter = rec.get("scatter_ns_per_row")
-            if gather and scatter:
-                return float(gather), float(scatter), "ROW_OP_FLOORS.json"
-    except (OSError, ValueError, TypeError):
-        pass
-    return _GATHER_NS_PER_ROW, _SCATTER_NS_PER_ROW, "builtin-r5"
+    return reader(path, fallback=(_GATHER_NS_PER_ROW,
+                                  _SCATTER_NS_PER_ROW),
+                  fallback_source="builtin-r5")
 
 
 def deepfm(sparse_feature_dim=100000, num_fields=26, embedding_size=16,

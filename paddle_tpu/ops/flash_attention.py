@@ -1356,11 +1356,107 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 # public entry: packed [B, T, H*D] layout used by the layers API
 # ---------------------------------------------------------------------------
 
+def kernel_plan(q_shape, k_shape, num_heads, esize, causal=False,
+                dropout_rate=0.0, bias_kind=None, rng_available=True,
+                platform_ok=True):
+    """The attention dispatch decision as a structured
+    ``ops.gates.GateDecision`` (ISSUE 15): ``kernel`` is which path runs
+    — ``dense_vmem`` (whole-sequence VMEM-resident, packed layout),
+    ``packed_stream`` (copy-free streaming), ``head_split_stream``
+    (legacy streaming + the [B,T,H,D] relayout copies), or
+    ``reference`` — and ``reasons`` records every check that demoted the
+    choice. This IS the dispatch logic :func:`flash_attention` runs
+    (single source); the static resource pass evaluates it shape-only
+    with ``platform_ok=True``.
+
+    ``bias_kind``: None | 'key' (padding-mask form) | 'rich' (anything
+    else — reference path only)."""
+    from ..core.op_registry import env_flag
+    from .gates import GateDecision, GateReason
+
+    b, t, hd = q_shape
+    t_k = k_shape[1]
+    d = hd // max(num_heads, 1)
+    reasons = []
+    if not platform_ok:
+        reasons.append(GateReason(
+            "platform", "not on TPU (or PADDLE_TPU_NO_FLASH=1)"))
+    if bias_kind == "rich":
+        reasons.append(GateReason(
+            "bias", "non-key-mask bias shape: only the additive "
+            "[B,1,1,Tk]/[B,Tk] padding-mask form streams"))
+    if d % 8 != 0:
+        reasons.append(GateReason(
+            "geometry", "head dim %d is not a multiple of 8 "
+            "(Mosaic-unfriendly; would be a lowering error)" % d))
+    if dropout_rate > 0.0 and (_INTERPRET or not rng_available):
+        reasons.append(GateReason(
+            "dropout", "attention dropout needs the TPU PRNG primitives "
+            "(interpret mode / no rng threaded)"))
+    if reasons:
+        return GateDecision(False, "reference", fallback="packed_stream",
+                            reasons=reasons)
+    if (t <= _DENSE_MAX_Q and t_k <= _DENSE_MAX_KV
+            and (not causal or t <= t_k)
+            and _dense_fits(t, t_k, hd, esize)):
+        return GateDecision(True, "dense_vmem")
+    if causal and t != t_k:
+        # the streaming kernels anchor the causal diagonal at position 0
+        # while mha_reference anchors it at the sequence end; they
+        # disagree for t != t_k, so only the square case streams
+        reasons.append(GateReason(
+            "geometry", "causal with t_q=%d != t_k=%d: streaming kernels "
+            "anchor the diagonal differently from the reference" % (t, t_k)))
+        return GateDecision(False, "reference", fallback="packed_stream",
+                            reasons=reasons)
+    if _PACKED_STREAM and not env_flag("PADDLE_TPU_SPLIT_STREAM"):
+        if _packed_stream_fits(t, t_k, hd, esize, num_heads,
+                               float(dropout_rate)):
+            return GateDecision(True, "packed_stream")
+        reasons.append(GateReason(
+            "vmem", "packed streaming working set for T=%d Tk=%d H*D=%d "
+            "exceeds the %.0f MB VMEM budget — falls back to the "
+            "head-split path (+[B,T,H,D] relayout copies around every "
+            "attention site)" % (t, t_k, hd,
+                                 _STREAM_VMEM_BUDGET / 2**20)))
+    else:
+        reasons.append(GateReason(
+            "env", "packed streaming disabled "
+            "(PADDLE_TPU_SPLIT_STREAM / module A/B switch)",
+            blocking=False))
+    return GateDecision(True, "head_split_stream",
+                        fallback="packed_stream", reasons=reasons)
+
+
+def plan_for(q, k, bias, num_heads, causal, dropout_rate, rng):
+    """:func:`kernel_plan` for concrete arrays: classifies the bias form
+    and evaluates the live platform gate. Used by the op impl (which
+    records the decision in the op's attrs) and by
+    :func:`flash_attention` itself."""
+    b, _, _ = q.shape
+    t_k = k.shape[1]
+    bias_kind = None
+    if bias is not None:
+        if (bias.ndim == 4 and bias.shape[1] == 1 and bias.shape[2] == 1
+                and bias.shape[0] in (1, b)) or \
+                (bias.ndim == 2 and bias.shape[0] in (1, b)):
+            bias_kind = "key"
+        else:
+            bias_kind = "rich"
+    return kernel_plan(q.shape, k.shape, num_heads, q.dtype.itemsize,
+                       causal=causal, dropout_rate=float(dropout_rate),
+                       bias_kind=bias_kind,
+                       rng_available=rng is not None,
+                       platform_ok=_use_pallas(q))
+
+
 def flash_attention(q, k, v, num_heads, bias=None, causal=False,
-                    dropout_rate=0.0, rng=None):
+                    dropout_rate=0.0, rng=None, plan=None):
     """q,k,v: [B, T, H*D] (packed heads). ``bias``: None or additive
     [B, 1, 1, Tk] / [B, Tk] key mask (the padding-mask form; richer bias
-    shapes fall back to the reference path). Returns [B, T, H*D]."""
+    shapes fall back to the reference path). Returns [B, T, H*D].
+    ``plan``: a precomputed :func:`plan_for` decision (the op impl
+    records it); None recomputes it here."""
     b, t, hd = q.shape
     d = hd // num_heads
     t_k = k.shape[1]
@@ -1381,14 +1477,10 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
 
     scale = 1.0 / math.sqrt(d)
 
-    pallas_ok = _use_pallas(q) and (bias is None or key_bias is not None)
-    # Mosaic-friendly head dims only; anything else degrades to the
-    # reference path instead of a lowering error
-    pallas_ok = pallas_ok and d % 8 == 0
-    if dropout_rate > 0.0 and (_INTERPRET or rng is None):
-        pallas_ok = False  # PRNG primitives are TPU-only
+    if plan is None:
+        plan = plan_for(q, k, bias, num_heads, causal, dropout_rate, rng)
 
-    if dropout_rate > 0.0 and pallas_ok:
+    if dropout_rate > 0.0 and plan.kernel != "reference":
         seed = jax.random.randint(rng, (), 0, np.iinfo(np.int32).max,
                                   dtype=jnp.int32).astype(jnp.uint32)
     else:
@@ -1398,25 +1490,13 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
     # layout (no head-split transposes, heads looped in-kernel). Causal
     # with t > t_k would create fully-masked rows, whose additive-mask
     # softmax (uniform over tk_pad incl. padding) diverges from the
-    # reference's uniform-over-real-keys — keep those on the fallback.
-    if (pallas_ok and t <= _DENSE_MAX_Q and t_k <= _DENSE_MAX_KV
-            and (not causal or t <= t_k)
-            and _dense_fits(t, t_k, hd, q.dtype.itemsize)):
+    # reference's uniform-over-real-keys — those stay on the fallback
+    # (kernel_plan encodes the rule).
+    if plan.kernel == "dense_vmem":
         return _dense_attention(q, k, v, key_bias, seed, num_heads, causal,
                                 scale, float(dropout_rate))
 
-    # the streaming kernels anchor the causal diagonal at position 0
-    # (q_pos >= k_pos) while mha_reference anchors it at the sequence END
-    # (tril k=t_k-t_q); for t_q != t_k they disagree, so only the square
-    # case takes the kernel
-    pallas_ok = pallas_ok and (not causal or t == t_k)
-
-    from ..core.op_registry import env_flag
-
-    if (pallas_ok and _PACKED_STREAM
-            and not env_flag("PADDLE_TPU_SPLIT_STREAM")
-            and _packed_stream_fits(t, t_k, hd, q.dtype.itemsize,
-                                    num_heads, float(dropout_rate))):
+    if plan.kernel == "packed_stream":
         # copy-free streaming path: the packed layout goes straight into
         # the kernels — no [B,T,H,D] head-split relayouts around the
         # custom calls (the ~36 ms/step at the seq-2048 bench config)
@@ -1428,13 +1508,13 @@ def flash_attention(q, k, v, num_heads, bias=None, causal=False,
 
     qh, kh, vh = split(q, t), split(k, t_k), split(v, t_k)
 
-    if not pallas_ok:
+    if plan.kernel == "reference":
         # dropout applies to the attention weights, matching the kernels
         out = mha_reference(qh, kh, vh, ref_bias, causal, scale,
                             dropout_rate=dropout_rate, rng=rng)
         return out.transpose(0, 2, 1, 3).reshape(b, t, hd)
 
-    # flatten heads into the grid's leading axis
+    # head-split streaming: flatten heads into the grid's leading axis
     qf = qh.reshape(b * num_heads, t, d)
     kf = kh.reshape(b * num_heads, t_k, d)
     vf = vh.reshape(b * num_heads, t_k, d)

@@ -80,25 +80,53 @@ def _fits_vmem(c, o, hw, esize, has_residual):
     return x_bytes + co_bytes + acc_bytes + res_bytes <= _VMEM_BUDGET
 
 
-def use_pallas(x_shape, w_shape, strides, paddings, dilations, groups,
-               esize, has_residual):
-    """Gate for the fused kernels (mirrors the other fused ops' gates)."""
+def gate(x_shape, w_shape, strides, paddings, dilations, groups, esize,
+         has_residual, static_only=False):
+    """Structured gate (``ops.gates.GateDecision``) for the fused
+    kernels (mirrors the other fused ops' gates). ``static_only=True``
+    evaluates only the geometry/VMEM checks — the platform-independent
+    view the static resource pass wants."""
+    from .gates import GateDecision, GateReason
+
+    reasons = []
     if not supported_geometry(x_shape, w_shape, strides, paddings,
                               dilations, groups):
-        return False
-    o, c, kh, kw = w_shape
-    h, w = int(x_shape[2]), int(x_shape[3])
-    if tuple(strides) == (2, 2):  # pre-sliced before the kernel
-        h, w = (h + 1) // 2, (w + 1) // 2
-    if not _fits_vmem(int(c), int(o), h * w, esize, has_residual):
-        return False
-    if _INTERPRET:
-        return True
-    from ..core.op_registry import env_flag, single_tpu
+        reasons.append(GateReason(
+            "geometry", "unsupported conv geometry: filter %s strides %s "
+            "paddings %s dilations %s groups %s (Pallas path covers the "
+            "1x1 s1/s2 and 3x3 s1 p1 bottleneck shapes)"
+            % (list(w_shape), list(strides), list(paddings),
+               list(dilations), groups)))
+    else:
+        o, c, kh, kw = w_shape
+        h, w = int(x_shape[2]), int(x_shape[3])
+        if tuple(strides) == (2, 2):  # pre-sliced before the kernel
+            h, w = (h + 1) // 2, (w + 1) // 2
+        if not _fits_vmem(int(c), int(o), h * w, esize, has_residual):
+            reasons.append(GateReason(
+                "vmem", "[C=%d, HW=%d] image + [O=%d] output blocks "
+                "exceed the %.0f MB VMEM budget"
+                % (int(c), h * w, int(o), _VMEM_BUDGET / 2**20)))
+    if not static_only and not reasons and not _INTERPRET:
+        from ..core.op_registry import env_flag, single_tpu
 
-    if env_flag("PADDLE_TPU_NO_FUSED_CONV"):  # A/B escape hatch
-        return False
-    return single_tpu()
+        if env_flag("PADDLE_TPU_NO_FUSED_CONV"):  # A/B escape hatch
+            reasons.append(GateReason("env", "PADDLE_TPU_NO_FUSED_CONV=1"))
+        elif not single_tpu():
+            reasons.append(GateReason(
+                "platform", "not a single TPU (a mesh would make the "
+                "custom call fight GSPMD)"))
+    if reasons:
+        return GateDecision(False, "unfused_replay",
+                            fallback="pallas_fused_conv", reasons=reasons)
+    return GateDecision(True, "pallas_fused_conv")
+
+
+def use_pallas(x_shape, w_shape, strides, paddings, dilations, groups,
+               esize, has_residual):
+    """Boolean view of :func:`gate` (the pre-ISSUE-15 surface)."""
+    return gate(x_shape, w_shape, strides, paddings, dilations, groups,
+                esize, has_residual).admitted
 
 
 # ---------------------------------------------------------------------------

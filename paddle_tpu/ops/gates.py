@@ -1,0 +1,89 @@
+"""Structured kernel-gate decisions (ISSUE 15 satellite).
+
+Every Pallas kernel family guards itself with a gate (VMEM budget,
+supported geometry, dtype, platform). Those gates used to answer with a
+bare bool — a refused kernel silently fell back and nothing recorded
+WHY. A :class:`GateDecision` carries the chosen kernel plus one
+:class:`GateReason` per failed (or decisive) check, so:
+
+  * op impls record the decision in their op's attrs
+    (``op.attrs["_kernel_choice"]``) at trace time — inspectable after
+    a build, cloned with the program;
+  * the static resource pass (``analysis/resources.py``) evaluates the
+    SAME gates shape-only (``static_only=True`` skips the platform
+    checks) and surfaces refusals as findings with op provenance;
+  * bench records keep reporting the honest kernel name.
+"""
+
+__all__ = ["GateReason", "GateDecision"]
+
+
+class GateReason:
+    """One gate check's outcome: the check name ('vmem' / 'geometry' /
+    'dtype' / 'platform' / 'env' / ...), a human detail string, and
+    whether this check blocked admission."""
+
+    __slots__ = ("check", "detail", "blocking")
+
+    def __init__(self, check, detail, blocking=True):
+        self.check = check
+        self.detail = detail
+        self.blocking = bool(blocking)
+
+    def to_dict(self):
+        return {"check": self.check, "detail": self.detail,
+                "blocking": self.blocking}
+
+    def __repr__(self):
+        return "GateReason(%s%s: %s)" % (
+            self.check, "" if self.blocking else " [info]", self.detail)
+
+
+class GateDecision:
+    """The gate's verdict: ``kernel`` is what will actually run (the
+    Pallas kernel name when admitted, the fallback name when refused);
+    ``reasons`` records every failed check (refusals) or decisive note.
+    Truthiness == admitted, so ``if gate(...):`` keeps working."""
+
+    __slots__ = ("admitted", "kernel", "fallback", "reasons")
+
+    def __init__(self, admitted, kernel, fallback=None, reasons=()):
+        self.admitted = bool(admitted)
+        self.kernel = kernel
+        self.fallback = fallback
+        self.reasons = list(reasons)
+
+    def __bool__(self):
+        return self.admitted
+
+    @property
+    def blocking_reasons(self):
+        return [r for r in self.reasons if r.blocking]
+
+    def blocked_only_by(self, *checks):
+        """True when every blocking reason is one of ``checks`` — e.g.
+        'the ONLY thing keeping this shape off the kernel is the VMEM
+        budget' (the actionable finding class)."""
+        blocking = self.blocking_reasons
+        return bool(blocking) and all(r.check in checks for r in blocking)
+
+    def to_dict(self):
+        return {"admitted": self.admitted, "kernel": self.kernel,
+                "fallback": self.fallback,
+                "reasons": [r.to_dict() for r in self.reasons]}
+
+    def describe(self):
+        why = "; ".join("%s: %s" % (r.check, r.detail)
+                        for r in self.blocking_reasons)
+        if self.admitted and not self.blocking_reasons:
+            return "kernel %s" % self.kernel
+        if self.admitted:
+            # admitted-with-demotion (e.g. head-split instead of the
+            # packed streaming path): the reason IS the actionable part
+            return "runs kernel %s instead of %s: %s" % (
+                self.kernel, self.fallback or "the preferred kernel", why)
+        return "fell back to %s (wanted %s): %s" % (
+            self.kernel, self.fallback or "pallas", why or "no reason")
+
+    def __repr__(self):
+        return "GateDecision(%s)" % self.describe()

@@ -42,7 +42,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-__all__ = ["scatter_add_rows", "use_pallas", "packed_vmem_bytes"]
+__all__ = ["scatter_add_rows", "gate", "use_pallas", "packed_vmem_bytes"]
 
 _LANES = 128
 _INTERPRET = False  # tests flip this to run the kernel on CPU
@@ -84,33 +84,80 @@ def packed_vmem_bytes(v, k, esize):
     return vp_pad * width_pad * esize
 
 
-def use_pallas(v, k, n, dtype):
-    """Gate: the packed table fits the VMEM budget, the row width packs
-    (or is already lane-aligned), and we are on a single TPU (a mesh
-    would make the custom call fight GSPMD) or under the test
-    interpreter."""
+def gate(v, k, n, dtype, static_only=False):
+    """Structured gate (``ops.gates.GateDecision``): the packed table
+    fits the VMEM budget, the row width packs (or is already
+    lane-aligned), and we are on a single TPU (a mesh would make the
+    custom call fight GSPMD) or under the test interpreter.
+    ``static_only=True`` evaluates ONLY the shape/dtype/VMEM checks —
+    the platform-independent view the static resource pass wants."""
+    from .gates import GateDecision, GateReason
     from .rowops import pack_factor
 
+    reasons = []
     if k <= 0 or v <= 0 or n <= 0:
-        return False
-    if pack_factor(k) == 1 and k % _LANES:
-        return False  # unpackable narrow width: lane padding explodes VMEM
+        reasons.append(GateReason(
+            "geometry", "degenerate table/update shape [%d, %d] x %d rows"
+            % (v, k, n)))
+    elif pack_factor(k) == 1 and k % _LANES:
+        reasons.append(GateReason(
+            "geometry", "row width %d neither packs into 128 lanes nor "
+            "aligns to them: lane padding would explode VMEM" % k))
     dt = jnp.dtype(dtype)
     if not jnp.issubdtype(dt, jnp.floating):
-        return False  # grad surfaces are float; int tables keep XLA
-    esize = dt.itemsize
-    if esize not in (2, 4):
-        return False
-    if packed_vmem_bytes(v, k, esize) + 2 * _CHUNK * max(k, _LANES) * esize \
-            > _vmem_budget():
-        return False
-    if _INTERPRET:
-        return True
-    from ..core.op_registry import env_flag, single_tpu
+        reasons.append(GateReason(
+            "dtype", "%s table: grad surfaces are float; int tables keep "
+            "the XLA scatter" % dt))
+        esize = 4
+    else:
+        esize = dt.itemsize
+        if esize not in (2, 4):
+            reasons.append(GateReason(
+                "dtype", "%d-byte float rows unsupported" % esize))
+    if not reasons:
+        need = packed_vmem_bytes(v, k, esize) \
+            + 2 * _CHUNK * max(k, _LANES) * esize
+        budget = _vmem_budget()
+        if need > budget:
+            reasons.append(GateReason(
+                "vmem", "packed [%d, %d] table + vals stream needs %.1f "
+                "MB VMEM, budget is %.1f MB "
+                "(PADDLE_TPU_SCATTER_VMEM_MB raises it)"
+                % (v, k, need / 2**20, budget / 2**20)))
+    if not static_only and not reasons and not _INTERPRET:
+        from ..core.op_registry import env_flag, single_tpu
 
-    if env_flag("PADDLE_TPU_NO_PALLAS_SCATTER"):  # A/B escape hatch
-        return False
-    return single_tpu()
+        if env_flag("PADDLE_TPU_NO_PALLAS_SCATTER"):  # A/B escape hatch
+            reasons.append(GateReason(
+                "env", "PADDLE_TPU_NO_PALLAS_SCATTER=1"))
+        elif not single_tpu():
+            reasons.append(GateReason(
+                "platform", "not a single TPU (a mesh would make the "
+                "custom call fight GSPMD)"))
+    if reasons:
+        return GateDecision(False, "xla_at_add", fallback="pallas_rowbin",
+                            reasons=reasons)
+    from ..core.op_registry import env_flag
+
+    kernel = ("pallas_sorted_segment"
+              if env_flag("PADDLE_TPU_SCATTER_SORT") else "pallas_rowbin")
+    return GateDecision(True, kernel)
+
+
+def use_pallas(v, k, n, dtype):
+    """Boolean view of :func:`gate` (the pre-ISSUE-15 surface)."""
+    return gate(v, k, n, dtype).admitted
+
+
+def record_choice(op, v, k, n, dtype):
+    """Evaluate the gate and record the structured decision in the
+    consuming op's attrs (``_kernel_choice``) — trace-time, so a built
+    program carries which kernel its sparse updates actually take and
+    why (the ISSUE 15 no-silent-fallback contract)."""
+    decision = gate(v, k, n, dtype)
+    if op is not None:
+        op.attrs["_kernel_choice"] = decision.to_dict()
+    return decision
 
 
 def _scatter_kernel(rows_ref, vals_ref, tab_in_ref, out_ref, *, chunk, p, k,

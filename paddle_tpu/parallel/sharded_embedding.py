@@ -78,22 +78,13 @@ def choose_strategy(n_ids, n_shards, width=None):
 
 def comm_bytes_model(n_ids, width, n_shards, esize=4):
     """Analytic per-step ICI bytes of both formulations (the bench
-    record's honesty line — re-derivable, not measured).
+    record's honesty line — re-derivable, not measured). DELEGATES to
+    the single comm model in ``analysis.cost`` (ISSUE 15): the bench
+    line, the static SPMD pass's per-collective volumes, and this
+    module can never disagree about the bytes."""
+    from ..analysis.cost import comm_bytes_model as model
 
-    psum: every shard contributes a FULL [n, D] partial; the reduction
-    combines mp of them (total reduced volume mp*n*D*e; per-link on a
-    bidirectional ring all-reduce ~2*(mp-1)/mp*n*D*e).
-    alltoall: n ids out + n*D payload back + (mp-1)/mp*n*D output
-    replication — per-shard O(n*D + n), mp-independent."""
-    n, d, m = int(n_ids), int(width), int(n_shards)
-    nd = n * d * esize
-    return {
-        "psum_total_bytes": m * nd,
-        "psum_per_link_bytes": int(2 * (m - 1) / max(m, 1) * nd),
-        "alltoall_total_bytes": n * 4 + nd + int((m - 1) / max(m, 1) * nd),
-        "alltoall_per_link_bytes": int(
-            (m - 1) / max(m, 1) * (n * 4 + 2 * nd)),
-    }
+    return model(n_ids, width, n_shards, esize=esize)
 
 
 def _psum_lookup(table, ids, mesh, axis):

@@ -54,9 +54,20 @@ from .engine import EngineShutdownError
 from .metrics import ServingMetrics
 
 __all__ = ["DecodeBatcher", "DecodeRequest", "save_decode_spec",
-           "load_decode_spec"]
+           "load_decode_spec", "default_ctx_ladder"]
 
 DECODE_SPEC_FILE = "decode_spec.json"
+
+
+def default_ctx_ladder(spec):
+    """The ctx-capacity rung ladder a decode spec gets when the caller
+    passes none: pow2 rungs up to the spec's cache capacity, floored at
+    16. THE single derivation — ``DecodeBatcher.__init__`` and
+    ``ServingEngine``'s build-time compile-cache verdict both call it,
+    so the proved executable bound can never desynchronize from the
+    ladder the batcher actually compiles."""
+    cap = int(spec.get("ctx_cap", 256) or 256)
+    return tuple(r for r in pow2_ladder(cap) if r >= 16) or (cap,)
 
 
 def save_decode_spec(dirname, spec):
@@ -162,8 +173,7 @@ class DecodeBatcher:
         self.ladder = tuple(sorted(set(
             ladder if ladder is not None else pow2_ladder(max_batch_size))))
         if ctx_ladder is None:
-            cap = int(self._spec.get("ctx_cap", 256))
-            ctx_ladder = [r for r in pow2_ladder(cap) if r >= 16] or [cap]
+            ctx_ladder = default_ctx_ladder(self._spec)
         self.ctx_ladder = tuple(sorted(set(int(c) for c in ctx_ladder)))
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.default_timeout_s = default_timeout_s
@@ -250,6 +260,16 @@ class DecodeBatcher:
         """Distinct (bucket_batch, bucket_ctx) geometries dispatched —
         bounded at ``len(ladder) * len(ctx_ladder)`` by construction."""
         return [len(self.seen_signatures)]
+
+    def compile_cache_bound(self):
+        """The PROVED executable-count bound (ISSUE 15): the static
+        compile-cache verdict from the decode spec — dispatched
+        geometries (:meth:`compiled_shape_counts`) can never exceed it."""
+        from ..analysis.resources import decode_cache_verdict
+
+        bound, _result = decode_cache_verdict(self._spec, self.ladder,
+                                              self.ctx_ladder)
+        return bound
 
     def warmup(self):
         """Pre-compile every (batch rung, ctx rung) geometry with a

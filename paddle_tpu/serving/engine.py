@@ -126,7 +126,11 @@ class ServingEngine:
                             "predictor with clone(); got %r" % (model,))
         self.ladder = tuple(sorted(set(
             ladder if ladder is not None else pow2_ladder(max_batch_size))))
-        self.seq_ladder = tuple(seq_ladder) if seq_ladder else None
+        # normalized exactly the way DecodeBatcher normalizes it, so the
+        # build-time compile-cache verdict and the batcher's actual
+        # ladder can never disagree
+        self.seq_ladder = tuple(sorted(set(
+            int(c) for c in seq_ladder))) if seq_ladder else None
         self.max_batch_size = max(self.ladder)
         self.feed_names = list(getattr(model, "feed_names", []))
         self.default_timeout_s = default_timeout_s
@@ -153,6 +157,11 @@ class ServingEngine:
         if decode_spec is not None:
             from .decode_batcher import DecodeBatcher
 
+            # build-time resource verification (ISSUE 15): prove the
+            # compile-cache bound from the decode spec — dead ctx rungs
+            # and an over-budget ladder product are construction-time
+            # warnings, not a production surprise at warmup
+            self._verify_decode_build(decode_spec)
             self._decoders = []
             for i in range(num_replicas):
                 parent = parents[i % len(parents)]
@@ -199,6 +208,28 @@ class ServingEngine:
                 target=self._supervisor_loop, args=(supervisor_interval_s,),
                 name="paddle-tpu-serve-supervisor", daemon=True)
             self._supervisor.start()
+
+    def _verify_decode_build(self, decode_spec):
+        """Static compile-cache verdict for the decode tier
+        (``analysis.resources.decode_cache_verdict``): the scheduler's
+        executable count is bounded by len(ladder) x len(valid ctx
+        rungs) — proved from the spec's cache capacity, checked against
+        the budget at CONSTRUCTION. Findings surface as warnings and the
+        result is kept on ``self.build_verification``; the proved bound
+        on ``self.compile_cache_bound``."""
+        from ..analysis.resources import decode_cache_verdict
+        from .decode_batcher import default_ctx_ladder
+
+        ctx_ladder = self.seq_ladder
+        if ctx_ladder is None:
+            ctx_ladder = default_ctx_ladder(decode_spec)
+        bound, result = decode_cache_verdict(decode_spec, self.ladder,
+                                             ctx_ladder)
+        self.compile_cache_bound = bound
+        self.build_verification = result
+        for d in result.diagnostics:
+            warnings.warn("serving build verification: %s" % d,
+                          RuntimeWarning, stacklevel=3)
 
     # -- placement ----------------------------------------------------------
     @staticmethod

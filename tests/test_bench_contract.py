@@ -137,6 +137,14 @@ def test_resnet50_record_carries_rederived_ceiling(monkeypatch):
     assert cfg["hbm_ceiling_source"] == "CHIP_CEILING.json"
     assert cfg["hbm_gbs"] == ceil["hbm_operative_gbs"]
     assert isinstance(cfg["fused_conv"], bool)
+    # ISSUE 15: every static-graph bench line carries the cost engine's
+    # re-derivable model of the measured program
+    sm = cfg["static_model"]
+    assert sm["flops_per_step"] > 0 and sm["hbm_bytes_per_step"] > 0
+    assert sm["roofline_ms_per_step"] > 0
+    assert sm["bound"] in ("compute", "hbm", "rows")
+    assert sm["ceilings_source"] == "CHIP_CEILING.json"
+    assert sm["row_floor_source"] in ("ROW_OP_FLOORS.json", "builtin-r5")
     # the sourcing is live, not a copied literal
     monkeypatch.setattr(bench, "_chip_ceiling",
                         lambda: {"hbm_operative_gbs": 777.0})
@@ -255,6 +263,13 @@ def test_deepfm_record_is_self_describing(monkeypatch):
                                      "xla_at_add")
     assert cfg["row_floors"]["source"] in ("ROW_OP_FLOORS.json",
                                            "builtin-r5")
+    # ISSUE 15 static model on the REAL deepfm program: row-bound, with
+    # the engine's row counts matching the bench's id count
+    sm = cfg["static_model"]
+    assert sm["bound"] == "rows"
+    assert sm["row_reads"] == cfg["batch"] * 26
+    assert sm["row_writes"] == cfg["batch"] * 26
+    assert sm["uncosted_ops"] == []
     # the A/B env reshapes the recorded strategy (sourcing is live)
     monkeypatch.setenv("PADDLE_TPU_EMB_PSUM", "1")
     main2, startup2 = fluid.Program(), fluid.Program()

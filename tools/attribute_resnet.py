@@ -43,14 +43,12 @@ def _ceilings():
     floors are computed at the MATRIX-derived operative HBM rate (ISSUE
     12: the single-pattern 552 GB/s figure is one row of the matrix, not
     the ceiling), falling back to the legacy constants when absent.
-    Shares bench._chip_ceiling so the bench records and these floors can
-    never read different constants."""
-    from bench import _chip_ceiling
+    Sourced through analysis.cost.operative_rates — the same reader the
+    bench records and the static cost engine use, so no two consumers
+    can read different constants."""
+    from paddle_tpu.analysis.cost import operative_rates
 
-    c = _chip_ceiling()
-    mm = (c.get("bf16_matmul_tflops") or 185.3) * 1e12
-    hbm = (c.get("hbm_operative_gbs") or c.get("hbm_stream_gbs")
-           or 552.2) * 1e9
+    mm, hbm, _src = operative_rates()
     return mm, hbm
 
 
@@ -110,86 +108,55 @@ def conv_shapes(program, batch):
 
 
 def floors(program, batch):
-    """Per-bucket (compute_s, bytes_s) floors from program op shapes.
-    bf16 activations/weights (AMP), f32 master params for adam.
+    """Per-bucket (compute_s, bytes_s) floors for the profile join —
+    DELEGATED to the static cost engine (``paddle_tpu.analysis.cost``,
+    ISSUE 15): the engine's per-op records ARE the bytes model (conv
+    fwd/dX/dW splits ride in the conv records' notes, BN/relu riders and
+    the stem-dX exclusion included), this function only re-buckets them
+    into the attribution categories. One model: what this prints, what
+    ``bench.py --attribute`` cross-checks against xplane-measured bytes,
+    and what the ``--cost`` CLI emits can never disagree.
 
-    dX of stride-2 convs is modeled at 4x fwd compute: XLA lowers it as
-    an lhs_dilated (zero-stuffed) convolution on the MXU, quadrupling
-    the MAC grid — a lowering property, so it belongs in the floor.
-    The stem conv's dX is excluded entirely (images carry no gradient;
-    XLA DCEs it)."""
-    convs = conv_shapes(program, batch)
-    e = 2  # bf16
+    Returns (bucket floors, conv_flops, model_bytes_total) — the same
+    surface as the pre-ISSUE-15 ad-hoc model (agreement with it is
+    pinned within 5% in tests/test_cost_engine.py)."""
+    from paddle_tpu.analysis.cost import estimate_program
 
-    conv_flops = 0
+    est = estimate_program(program, batch=batch, amp=True)
     fwd_comp = dx_comp = dw_comp = 0.0
-    conv_fwd_bytes = conv_dx_bytes = conv_dw_bytes = 0
-    act_elems = 0  # conv output elements (bn/relu ride these)
-    for i, (name, xs, ws, os_) in enumerate(convs):
-        n, c, h, w_ = xs
-        o, _, kh, kw = ws
-        _, _, oh, ow = os_
-        f = 2.0 * n * o * oh * ow * c * kh * kw
-        stride2 = h > oh  # resnet uses stride only to halve resolution
-        is_stem = (i == 0)
-        conv_flops += f * (3 if not is_stem else 2)
-        fwd_comp += f
-        dw_comp += f
-        if not is_stem:
-            dx_comp += f * (4 if stride2 else 1)
-        x_b = n * c * h * w_ * e
-        y_b = n * o * oh * ow * e
-        w_b = o * c * kh * kw * e
-        conv_fwd_bytes += x_b + w_b + y_b
-        if not is_stem:
-            conv_dx_bytes += y_b + w_b + x_b  # dout, w -> dx
-        conv_dw_bytes += x_b + y_b + o * c * kh * kw * 4  # f32 dw
-        act_elems += n * o * oh * ow
+    conv_fwd_bytes = conv_dx_bytes = conv_dw_bytes = 0.0
+    conv_flops = 0.0
+    res_bytes = pool_bytes = adam_bytes = 0.0
+    for r in est.records:
+        t = r.op.type
+        note = r.note if isinstance(r.note, dict) else {}
+        if note.get("kind") == "conv":
+            fwd_comp += r.flops
+            conv_fwd_bytes += r.hbm_bytes
+            ride_half = note.get("ride_bytes", 0) / 2.0
+            if r.bwd_counted:
+                dx_comp += note.get("dx_flops", 0.0)
+                dw_comp += note.get("dw_flops", 0.0)
+                conv_dx_bytes += note.get("dx_bytes", 0.0) + ride_half
+                conv_dw_bytes += note.get("dw_bytes", 0.0) + ride_half
+            # the legacy headline figure: fwd + dW + dX-at-1x
+            conv_flops += r.flops * 2 + note.get("fwd_1x", 0.0)
+        elif t.startswith("elementwise"):
+            res_bytes += r.hbm_bytes
+        elif t == "pool2d":
+            pool_bytes += r.hbm_bytes + (r.bwd_hbm_bytes
+                                         if r.bwd_counted else 0)
+        elif t in ("adam", "sgd", "momentum", "adamax", "adagrad",
+                   "rmsprop", "adadelta", "lamb", "ftrl",
+                   "decayed_adagrad", "lars_momentum"):
+            adam_bytes += r.hbm_bytes
 
-    # BN + relu ride the conv fusions in this build (measured standalone
-    # BN time ~0.6 ms): fwd stats/scale/shift fuse into the conv output
-    # pass (no extra traffic), but the BACKWARD necessarily re-reads
-    # activations the plain conv-bwd model doesn't count — the relu
-    # mask + BN x-hat read rides the dX fusions, and the dgamma/dbeta
-    # reduction reads ride the dW fusions. One full activation pass is
-    # therefore added to each of the dx/dw bytes floors below.
-    act_pass = act_elems * e
-    bn_bytes = 0  # realized inside the conv fusions
-    # maxpool: one pool site after the stem; fwd read+write, bwd
-    # (select-and-scatter) read x, dy, write dx
-    pool_bytes = 0
-    gb = program.global_block()
-    for op in gb.ops:
-        if op.type == "pool2d" and op.attr("pooling_type", "max") == "max":
-            x = op.input("X")
-            o = op.output("Out")
-            xb = batch * int(np.prod(x.shape[1:])) * e
-            ob = batch * int(np.prod(o.shape[1:])) * e
-            pool_bytes += (xb + ob) + (xb + 2 * ob)   # fwd + bwd
-    # adam: p/m/v read+write per step, f32 (25.6M params)
-    import paddle_tpu as fluid
-    n_params = sum(int(np.prod(p.shape))
-                   for p in program.all_parameters())
-    adam_bytes = 6 * n_params * 4
-
-    # residual adds: 2 reads + 1 write of each merge-site tensor in fwd
-    # (backward add-grads are pass-throughs, no traffic)
-    res_bytes = 0
-    for op in gb.ops:
-        if op.type == "elementwise_add":
-            x = op.input("X")
-            if x is not None and x.shape is not None and len(x.shape) == 4:
-                res_bytes += 3 * batch * int(np.prod(x.shape[1:])) * e
-
-    bytes_total = (conv_fwd_bytes + conv_dx_bytes + conv_dw_bytes
-                   + 2 * act_pass + pool_bytes + adam_bytes + res_bytes)
+    bytes_total = est.hbm_bytes
     return {
         "conv-fwd": (fwd_comp / MATMUL_TFLOPS, conv_fwd_bytes / HBM_GBS),
-        "conv-bwd-dx": (dx_comp / MATMUL_TFLOPS,
-                        (conv_dx_bytes + act_pass) / HBM_GBS),
-        "conv-bwd-dw": (dw_comp / MATMUL_TFLOPS,
-                        (conv_dw_bytes + act_pass) / HBM_GBS),
-        "batch-norm": (0.0, bn_bytes / HBM_GBS),
+        "conv-bwd-dx": (dx_comp / MATMUL_TFLOPS, conv_dx_bytes / HBM_GBS),
+        "conv-bwd-dw": (dw_comp / MATMUL_TFLOPS, conv_dw_bytes / HBM_GBS),
+        "batch-norm": (0.0, 0.0),  # realized inside the conv fusions
         "relu-elementwise": (0.0, res_bytes / HBM_GBS),
         "maxpool": (0.0, pool_bytes / HBM_GBS),
         "adam-update": (0.0, adam_bytes / HBM_GBS),

@@ -1,11 +1,16 @@
 #!/usr/bin/env bash
 # Repo lint gate (wired into the test suite via tests/test_lint.py).
 #
-# Primary: `ruff check` with the enforced floor configured in
-# pyproject.toml [tool.ruff.lint] (syntax errors, unused/undefined
-# names, broken comparisons, redefinitions). When ruff is not in the
-# image (nothing may be pip-installed here), degrade to a pure-stdlib
-# syntax gate so the check still refuses unparseable code.
+# Two sections:
+#   1. `ruff check` with the enforced floor configured in pyproject.toml
+#      [tool.ruff.lint] (syntax errors, unused/undefined names, broken
+#      comparisons, redefinitions). When ruff is not in the image
+#      (nothing may be pip-installed here), degrade to a pure-stdlib
+#      syntax gate so the check still refuses unparseable code.
+#   2. the static-analysis zoo sweep (`python -m paddle_tpu.analysis
+#      --zoo`, which since ISSUE 15 also runs the COST pass over every
+#      zoo program) — the verifier's regression corpus must stay at zero
+#      findings and every cost rule must run without crashing.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -13,10 +18,13 @@ TARGETS=(paddle_tpu tests tools bench.py)
 PY="${PYTHON:-$(command -v python3 || command -v python)}"
 
 if command -v ruff >/dev/null 2>&1; then
-    exec ruff check "${TARGETS[@]}"
+    ruff check "${TARGETS[@]}"
 elif "$PY" -c "import ruff" >/dev/null 2>&1; then
-    exec "$PY" -m ruff check "${TARGETS[@]}"
+    "$PY" -m ruff check "${TARGETS[@]}"
 else
     echo "lint.sh: ruff unavailable; falling back to compileall syntax gate" >&2
-    exec "$PY" -m compileall -q -f "${TARGETS[@]}"
+    "$PY" -m compileall -q -f "${TARGETS[@]}"
 fi
+
+JAX_PLATFORMS=cpu "$PY" -m paddle_tpu.analysis --zoo -q
+echo "lint.sh: ok"
