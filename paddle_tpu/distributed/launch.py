@@ -40,7 +40,30 @@ import subprocess
 import sys
 import time
 
-__all__ = ["launch"]
+__all__ = ["launch", "reap_procs"]
+
+
+def reap_procs(procs, sig=signal.SIGTERM, grace_s=10.0):
+    """Signal every live ``Popen`` in ``procs`` and wait it out;
+    stragglers get SIGKILL. The one way any supervisor here (this
+    launcher, ``serving.router.Router``) ends a child — never orphan
+    the subprocess tree, never wait unboundedly."""
+    live = [p for p in procs if p is not None and p.poll() is None]
+    for p in live:
+        try:
+            p.send_signal(sig)
+        except OSError:
+            pass
+    deadline = time.time() + grace_s
+    for p in live:
+        try:
+            p.wait(max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            try:
+                p.wait(5.0)
+            except subprocess.TimeoutExpired:
+                pass
 
 
 def _parse_args(argv):
@@ -87,23 +110,7 @@ class _Worker:
 
 def _reap(workers, sig=signal.SIGTERM, grace_s=10.0):
     """Signal every live worker and wait it out; stragglers get SIGKILL."""
-    live = [w for w in workers if w.proc is not None
-            and w.proc.poll() is None]
-    for w in live:
-        try:
-            w.proc.send_signal(sig)
-        except OSError:
-            pass
-    deadline = time.time() + grace_s
-    for w in live:
-        try:
-            w.proc.wait(max(0.1, deadline - time.time()))
-        except subprocess.TimeoutExpired:
-            w.proc.kill()
-            try:
-                w.proc.wait(5.0)
-            except subprocess.TimeoutExpired:
-                pass
+    reap_procs([w.proc for w in workers], sig=sig, grace_s=grace_s)
 
 
 def launch(argv=None):

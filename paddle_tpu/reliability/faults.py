@@ -18,7 +18,24 @@ Sites in-tree: ``predictor.run`` (serving batch dispatch),
 ``serving.worker`` (worker-thread top of loop — thread-death drills for
 the supervisor), ``checkpoint.write`` (array-file writes; ``corrupt``
 flips bytes post-write), ``recordio.read`` (async ingest; ``corrupt``
-truncates the record so the bounded-skip path engages).
+truncates the record so the bounded-skip path engages), and the
+multi-process serving path (``serving/rpc.py`` + ``serving/router.py``):
+``rpc.send`` (before a frame is written; ``corrupt`` ships a damaged
+payload the peer rejects), ``rpc.recv`` (before a frame is read;
+``corrupt`` damages the received payload pre-parse), ``router.dispatch``
+(router -> worker hop; ``error`` exercises the one-cross-worker-retry
+path, ``hang(s)`` burns the propagated deadline in the router), and
+``worker.heartbeat`` (tripped in the router's health loop before each
+ping — ``error`` fakes a missed heartbeat, feeding the per-worker
+breaker and the respawn path).
+
+Multi-process note: the env grammar is how faults cross a process
+boundary — the router passes ``worker_env={"PADDLE_TPU_FAULTS":
+"predictor.run:error@1"}`` to chaos a whole worker tier, e.g.::
+
+    PADDLE_TPU_FAULTS="rpc.send:error@2"           # 2nd frame send dies
+    PADDLE_TPU_FAULTS="router.dispatch:hang(0.3)@1" # burn 1st dispatch
+    PADDLE_TPU_FAULTS="worker.heartbeat:error@1-3"  # 3 missed pings
 
 Determinism: explicit specs name 1-based invocation numbers per site.
 Random ("chaos") plans draw per-(site, invocation) decisions from a

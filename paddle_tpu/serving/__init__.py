@@ -15,6 +15,14 @@ the architecture; quickstart:
     prob, = fut.result(timeout=1.0)
     print(eng.metrics_report())
     eng.shutdown(drain=True)
+
+Multi-process front door (``router.py`` / ``worker.py`` / ``rpc.py``):
+
+    router = serving.Router("my/model/dir", num_workers=4)
+    router.start()
+    client = serving.RouterClient(router.address)
+    prob, = client.predict({"x": rows}, timeout_s=1.0)
+    client.close(); router.shutdown()
 """
 
 from .admission import (AdmissionController, DeadlineExceededError,  # noqa: F401
@@ -26,10 +34,13 @@ from .decode_batcher import (DecodeBatcher, DecodeRequest,  # noqa: F401
                              load_decode_spec, save_decode_spec)
 from .engine import EngineShutdownError, ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .router import (Router, RouterClient, RouterShutdownError,  # noqa: F401
+                     WorkerFailedError)
 
 __all__ = ["ServingEngine", "EngineShutdownError", "DynamicBatcher",
            "Request", "ServingMetrics", "AdmissionController",
            "ServerOverloadedError", "DeadlineExceededError", "BucketError",
            "pow2_ladder", "bucket_for", "pad_to_bucket", "unpad_fetch",
            "DecodeBatcher", "DecodeRequest", "save_decode_spec",
-           "load_decode_spec"]
+           "load_decode_spec", "Router", "RouterClient",
+           "WorkerFailedError", "RouterShutdownError"]

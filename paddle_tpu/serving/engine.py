@@ -140,6 +140,10 @@ class ServingEngine:
         self._batcher = DynamicBatcher(self.max_batch_size,
                                        max_wait_ms=max_wait_ms, clock=clock)
         self._admission = AdmissionController(max_queue_depth)
+        # serializes the shutdown/_closed transition against supervisor
+        # respawns (see _maybe_respawn) — created before the decode-mode
+        # early return so both construction paths have it
+        self._lifecycle_lock = threading.Lock()
         self.metrics_ = ServingMetrics(latency_window=latency_window)
         self.metrics_.bind_gauges(self._batcher.depth,
                                   lambda: self._admission.in_flight)
@@ -484,10 +488,15 @@ class ServingEngine:
         stuck replica raced a full batcher — fail with
         :class:`EngineShutdownError` and return their admission slots
         rather than leaking callers' futures. Idempotent."""
-        self._closed = True
-        if self._shutdown_done:
-            return
-        self._shutdown_done = True
+        # _closed flips under _lifecycle_lock: once we hold it, no
+        # in-progress _maybe_respawn can still spawn a thread, and none
+        # started after this point will — every worker thread the join
+        # sweep below must reap already exists
+        with self._lifecycle_lock:
+            self._closed = True
+            if self._shutdown_done:
+                return
+            self._shutdown_done = True
         self._stop_event.set()
         if self._decoders is not None:
             for d in self._decoders:
@@ -563,6 +572,22 @@ class ServingEngine:
             name="paddle-tpu-serve-%d" % worker.index, daemon=True)
         worker.thread.start()
 
+    def _maybe_respawn(self, w):
+        """Respawn ``w``'s dead thread — unless shutdown has begun. The
+        ``_closed`` check and the spawn are one atomic step under
+        ``_lifecycle_lock``: without it the supervisor could pass the
+        check, lose the CPU to ``shutdown()``'s join sweep, then spawn a
+        thread nobody will ever join — parked forever on a closed
+        batcher. Returns True iff a thread was actually spawned."""
+        with self._lifecycle_lock:
+            if self._closed:
+                return False
+            if w.thread is not None and not w.thread.is_alive():
+                self._spawn_worker_thread(w)
+                self.metrics_.observe_respawned()
+                return True
+        return False
+
     def _supervisor_loop(self, interval_s):
         """Self-healing sweep: a worker thread that died outright (an
         escape below the batch-level containment) is respawned; its
@@ -574,9 +599,7 @@ class ServingEngine:
             for w in self._workers:
                 if self._closed:
                     return
-                if w.thread is not None and not w.thread.is_alive():
-                    self._spawn_worker_thread(w)
-                    self.metrics_.observe_respawned()
+                self._maybe_respawn(w)
 
     def _worker_loop(self, worker):
         while True:

@@ -37,6 +37,14 @@ class ServingMetrics:
         self._retried = 0
         self._evicted = 0
         self._respawned = 0
+        # router-tier counters (multi-process front door, ISSUE 16):
+        # the in-process counters above count what one engine did; these
+        # count what the DOOR did across workers
+        self._door_shed = 0
+        self._rerouted = 0
+        self._respawns = 0
+        self._heartbeat_misses = 0
+        self._deadline_refused = 0
         self._batches = 0
         self._batched_examples = 0
         self._bucket_slots = 0
@@ -91,6 +99,36 @@ class ServingMetrics:
         with self._lock:
             self._respawned += 1
 
+    def observe_door_shed(self, n=1):
+        """An admitted request displaced AT THE ROUTER DOOR (EDF, before
+        any worker saw it) by a new arrival with an earlier deadline."""
+        with self._lock:
+            self._door_shed += n
+
+    def observe_rerouted(self, n=1):
+        """A request sent to a different worker than first choice —
+        either its preferred worker was unhealthy/at-capacity at pick
+        time, or its dispatch failed and the one cross-worker retry ran."""
+        with self._lock:
+            self._rerouted += n
+
+    def observe_respawn(self, n=1):
+        """A worker PROCESS was restarted (crash, breaker trip, or
+        heartbeat loss) and came back ready."""
+        with self._lock:
+            self._respawns += n
+
+    def observe_heartbeat_miss(self, n=1):
+        with self._lock:
+            self._heartbeat_misses += n
+
+    def observe_deadline_refused(self, n=1):
+        """A worker refused a request whose propagated budget was already
+        spent — deadline propagation doing its job (the alternative is
+        executing work nobody is waiting for)."""
+        with self._lock:
+            self._deadline_refused += n
+
     def observe_decode_step(self, live, bucket, generated):
         """One pass of the continuous-batching decode loop: ``live``
         occupied slots out of ``bucket`` (the padded slot-table size),
@@ -138,6 +176,11 @@ class ServingMetrics:
                 "requests_retried": self._retried,
                 "replicas_evicted": self._evicted,
                 "workers_respawned": self._respawned,
+                "door_shed": self._door_shed,
+                "rerouted": self._rerouted,
+                "respawns": self._respawns,
+                "heartbeat_misses": self._heartbeat_misses,
+                "deadline_refused": self._deadline_refused,
                 "queue_depth": self._queue_depth_fn(),
                 "in_flight": self._in_flight_fn(),
                 "batches": batches,
@@ -175,7 +218,9 @@ class ServingMetrics:
         for key in ("requests_completed", "requests_failed",
                     "requests_rejected", "requests_expired",
                     "requests_shed", "requests_retried",
-                    "replicas_evicted", "workers_respawned", "queue_depth",
+                    "replicas_evicted", "workers_respawned",
+                    "door_shed", "rerouted", "respawns",
+                    "heartbeat_misses", "deadline_refused", "queue_depth",
                     "in_flight", "batches", "avg_batch_size",
                     "batch_occupancy", "compile_cache_hits",
                     "compile_cache_misses", "compile_cache_hit_rate",

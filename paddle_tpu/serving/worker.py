@@ -1,0 +1,258 @@
+"""Serving worker process: one :class:`ServingEngine` behind a socket.
+
+Spawned and supervised by :mod:`paddle_tpu.serving.router`; runnable by
+hand for debugging::
+
+    python -m paddle_tpu.serving.worker --model path/to/saved_model \\
+        --port 0 --replicas 1
+
+The worker binds an EPHEMERAL port (``--port 0``, the default — never a
+fixed port: parallel test runs and respawns must not race on one) and
+announces it on stdout as a single machine-readable line::
+
+    PADDLE_TPU_WORKER_READY {"port": 41123, "pid": 7}
+
+which the router parses to learn the address. Everything after that line
+is diagnostics.
+
+Protocol (one ``rpc`` frame in, one out, persistent connections):
+
+  * ``{"type": "ping"}`` -> ``{"type": "pong", "stats": {...}}`` — the
+    health-check probe, answering with live engine gauges (queue depth,
+    in-flight, deadline_refused) the router folds into routing.
+  * ``{"type": "infer", "deadline_s": <remaining-or-null>, ...}`` plus
+    the feed arrays -> ``{"type": "result", "n_out": N}`` plus fetch
+    arrays ``o0..o{N-1}``, or a typed ``{"type": "error", "error":
+    <kind>, "message": ...}``. A request whose propagated budget is
+    already spent is REFUSED before it touches the engine
+    (``error: "DeadlineRefused"``) — expired work must not occupy a
+    batch slot anywhere on the path.
+  * ``{"type": "shutdown"}`` -> acked, then the process drains and exits.
+
+``--model`` takes a ``save_inference_model`` directory or a
+``builtin:<name>`` spec (``builtin:fc`` tiny classifier,
+``builtin:mt_greedy`` the machine-translation greedy While-loop decoder)
+— the builtins exist so tests and the chaos harness can prove the door
+is model-agnostic without shipping model files around.
+"""
+
+import argparse
+import json
+import os
+import signal
+import socketserver
+import sys
+import threading
+
+import numpy as np
+
+from ..reliability import faults
+from . import rpc
+from .admission import DeadlineExceededError, ServerOverloadedError
+
+__all__ = ["READY_PREFIX", "main", "build_model"]
+
+READY_PREFIX = "PADDLE_TPU_WORKER_READY "
+
+
+def build_model(spec):
+    """Resolve ``--model``: a saved-model directory passes through (the
+    engine loads it); ``builtin:<name>`` builds a small in-process
+    program + scope and returns a ``ProgramPredictor`` over it."""
+    if not spec.startswith("builtin:"):
+        return spec
+    name = spec.split(":", 1)[1]
+    import paddle_tpu as fluid
+    from ..inference import ProgramPredictor
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        if name == "fc":
+            x = fluid.layers.data("x", shape=[8])
+            prob = fluid.layers.softmax(fluid.layers.fc(x, size=4))
+            fetches, feeds = [prob], ["x"]
+        elif name == "mt_greedy":
+            from ..models import machine_translation as mt
+
+            ids, scores = mt.seq2seq_attention_greedy_infer(
+                src_vocab=32, trg_vocab=32, seq_len=6, emb_dim=8,
+                hid_dim=8, max_out_len=4)
+            fetches, feeds = [ids, scores], ["src_ids", "src_len"]
+        else:
+            raise SystemExit("unknown builtin model %r (have: fc, "
+                             "mt_greedy)" % name)
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+    return ProgramPredictor(main_prog, feeds, fetches, scope=scope)
+
+
+class _WorkerState:
+    """Counters + the engine, shared with every connection thread."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        self.lock = threading.Lock()
+        self.deadline_refused = 0
+        self.served = 0
+        self.stop = threading.Event()
+
+
+def _stats(state):
+    eng = state.engine
+    with state.lock:
+        refused, served = state.deadline_refused, state.served
+    return {
+        "pid": os.getpid(),
+        "queue_depth": eng._batcher.depth() if eng._batcher else 0,
+        "in_flight": eng._admission.in_flight,
+        "deadline_refused": refused,
+        "served": served,
+    }
+
+
+def _handle_infer(state, header, arrays):
+    """One request end-to-end; ALWAYS returns a response pair — an
+    accepted frame never goes unanswered (zero-silent-loss starts here)."""
+    remaining = header.get("deadline_s")
+    if remaining is not None and remaining <= 0:
+        # deadline propagation's whole point: budget spent in transit or
+        # in the router queue is refused BEFORE an engine slot is wasted
+        with state.lock:
+            state.deadline_refused += 1
+        return {"type": "error", "error": "DeadlineRefused",
+                "message": "request budget expired %.3fs before it "
+                           "reached the worker" % -remaining}, None
+    try:
+        fut = state.engine.submit(dict(arrays), timeout_s=remaining)
+        outs = fut.result(remaining + 30.0 if remaining is not None
+                          else 300.0)
+    except ServerOverloadedError as e:
+        return {"type": "error", "error": "ServerOverloaded",
+                "message": str(e)}, None
+    except DeadlineExceededError as e:
+        return {"type": "error", "error": "DeadlineExceeded",
+                "message": str(e)}, None
+    except Exception as e:
+        return {"type": "error", "error": "WorkerFailed",
+                "message": "%s: %s" % (type(e).__name__, e)}, None
+    with state.lock:
+        state.served += 1
+    out_arrays = {"o%d" % i: np.asarray(o) for i, o in enumerate(outs)}
+    return {"type": "result", "n_out": len(outs)}, out_arrays
+
+
+def _make_server(host, port, state):
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            sock = self.request
+            while not state.stop.is_set():
+                try:
+                    header, arrays = rpc.recv_msg(sock)
+                except rpc.ConnectionClosed:
+                    return
+                except rpc.RpcError as e:
+                    # torn/corrupt frame: answer typed if the pipe still
+                    # works, then drop the connection (framing is lost)
+                    try:
+                        rpc.send_msg(sock, {"type": "error",
+                                            "error": "Rpc",
+                                            "message": str(e)})
+                    except Exception:
+                        pass
+                    return
+                kind = header.get("type")
+                if kind == "ping":
+                    resp, out = {"type": "pong",
+                                 "stats": _stats(state)}, None
+                elif kind == "infer":
+                    resp, out = _handle_infer(state, header, arrays)
+                elif kind == "shutdown":
+                    resp, out = {"type": "ok"}, None
+                else:
+                    resp, out = {"type": "error", "error": "Rpc",
+                                 "message": "unknown message type %r"
+                                            % kind}, None
+                try:
+                    rpc.send_msg(sock, resp, out)
+                except rpc.RpcError:
+                    return
+                if kind == "shutdown":
+                    state.stop.set()
+                    threading.Thread(target=self.server.shutdown,
+                                     daemon=True).start()
+                    return
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    return Server((host, port), Handler)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.serving.worker",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--model", required=True,
+                    help="saved-model dir or builtin:<fc|mt_greedy>")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="0 (default) binds an ephemeral port, announced "
+                         "on stdout")
+    ap.add_argument("--replicas", type=int, default=1)
+    ap.add_argument("--ladder", default="1,2,4,8",
+                    help="comma batch rungs for the engine bucket ladder")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0)
+    ap.add_argument("--max-queue-depth", type=int, default=256)
+    ap.add_argument("--placement", default="single",
+                    choices=["single", "per_device"])
+    ap.add_argument("--mp", type=int, default=1)
+    ap.add_argument("--warmup", action="store_true",
+                    help="pre-compile every bucket rung before READY")
+    args = ap.parse_args(argv)
+
+    faults.maybe_install_from_env()
+    from .engine import ServingEngine
+
+    ladder = tuple(int(x) for x in args.ladder.split(",") if x.strip())
+    engine = ServingEngine(build_model(args.model),
+                           num_replicas=args.replicas, ladder=ladder,
+                           max_wait_ms=args.max_wait_ms,
+                           max_queue_depth=args.max_queue_depth,
+                           placement=args.placement, mp=args.mp)
+    if args.warmup:
+        try:
+            engine.warmup()
+        except Exception as e:  # warmup is an optimization, not a gate
+            print("worker: warmup failed: %r" % e, file=sys.stderr)
+
+    state = _WorkerState(engine)
+    server = _make_server(args.host, args.port, state)
+    port = server.server_address[1]
+
+    def _on_term(signum, frame):
+        state.stop.set()
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    try:
+        signal.signal(signal.SIGTERM, _on_term)
+        signal.signal(signal.SIGINT, _on_term)
+    except ValueError:
+        pass  # not the main thread (in-process test harness)
+
+    print(READY_PREFIX + json.dumps(
+        {"port": port, "pid": os.getpid(), "model": args.model}),
+        flush=True)
+    try:
+        server.serve_forever(poll_interval=0.1)
+    finally:
+        server.server_close()
+        engine.shutdown(drain=True, timeout_s=5.0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

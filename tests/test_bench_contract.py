@@ -43,6 +43,11 @@ def test_serving_bench_record(monkeypatch):
     monkeypatch.setenv("BENCH_SERVING_RATES", "150,300")
     monkeypatch.setenv("BENCH_SERVING_REPLICAS", "1")
     monkeypatch.setenv("BENCH_DECODE_REQUESTS", "10")
+    # router tier kept tiny for tier-1: two fleets (1 then 2 worker
+    # processes), one rate, 8 requests each
+    monkeypatch.setenv("BENCH_ROUTER_WORKERS", "1,2")
+    monkeypatch.setenv("BENCH_ROUTER_REQUESTS", "8")
+    monkeypatch.setenv("BENCH_ROUTER_RATES", "60")
     rec = bench._bench_serving(on_tpu=False)
     assert rec["metric"] == "serving_requests_per_sec"
     assert rec["unit"] == "requests/sec"
@@ -59,6 +64,24 @@ def test_serving_bench_record(monkeypatch):
     for row in rec["rate_sweep"]:
         assert {"rate", "completed_rps", "p99_s", "rejected", "expired",
                 "met_slo"} <= set(row)
+    # router tier (ISSUE 16): the multi-process front door's per-N
+    # scaling rows with the door's reliability counters — the SLO
+    # harness contract for the socket path
+    router = rec["router"]
+    assert router["mode"] == "multiprocess-router"
+    assert router["worker_counts"] == [1, 2]
+    assert router["p99_budget_s"] > 0
+    assert "scaling_vs_1worker" in router and "scaling_claim" in router
+    assert [r["workers"] for r in router["rows"]] == [1, 2]
+    for row in router["rows"]:
+        assert {"workers", "best_rps", "p99_s", "rate_sweep", "door_shed",
+                "rerouted", "respawns", "deadline_refused"} <= set(row)
+        assert [s["rate"] for s in row["rate_sweep"]] == [60.0]
+        for s in row["rate_sweep"]:
+            assert {"rate", "completed_rps", "p99_s", "rejected",
+                    "expired", "errors", "met_slo"} <= set(s)
+        # a healthy smoke run earns its numbers without degradation
+        assert row["respawns"] == 0 and row["deadline_refused"] == 0
     # decode-tier gauges (continuous batcher)
     assert rec["ttft_p99"] is not None and rec["ttft_p99"] > 0
     assert rec["tpot_p50"] is not None and rec["tpot_p50"] > 0

@@ -395,6 +395,39 @@ def test_engine_supervisor_respawns_dead_workers():
             eng.shutdown()
 
 
+def test_engine_shutdown_blocks_supervisor_respawn():
+    """Regression (ISSUE 16): the supervisor could pass its _closed
+    check, lose the CPU to shutdown()'s join sweep, then respawn a
+    worker thread nobody would ever join — parked on a closed batcher.
+    The _closed flip and the respawn check are now one atomic step under
+    _lifecycle_lock: after shutdown() begins, _maybe_respawn must refuse
+    even though the dead-thread condition still holds."""
+    plan = FaultPlan.from_spec("serving.worker:error@1")
+    with fault_scope(plan):
+        eng = ServingEngine(FakePredictor(), num_replicas=1, ladder=(1,),
+                            max_wait_ms=0, max_queue_depth=4,
+                            supervisor_interval_s=None)  # swept by hand
+        try:
+            w = eng._workers[0]
+            # the injected fault kills the worker thread on its first pass
+            t0 = time.time()
+            while w.thread.is_alive():
+                assert time.time() - t0 < 10.0, "worker never died"
+                time.sleep(0.005)
+            # before shutdown the sweep respawns as always...
+            assert eng._maybe_respawn(w) is True
+            assert eng.metrics()["workers_respawned"] == 1
+        finally:
+            eng.shutdown()
+        # ...after shutdown the respawned thread has exited again (closed
+        # batcher) so the dead-thread condition re-arms — and the sweep
+        # must now refuse
+        w.thread.join(10.0)
+        assert not w.thread.is_alive()
+        assert eng._maybe_respawn(w) is False
+        assert eng.metrics()["workers_respawned"] == 1
+
+
 def test_engine_shutdown_warns_on_stuck_replica_and_releases_queue():
     gate = threading.Event()
     eng = ServingEngine(FakePredictor(gate), num_replicas=1, ladder=(1,),

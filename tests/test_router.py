@@ -1,0 +1,428 @@
+"""Multi-process serving front door (ISSUE 16): rpc framing, router
+admission/routing/deadline propagation, worker supervision, and the
+chaos drills — SIGKILL mid-request, heartbeat loss, wire faults.
+
+Hermeticity rules (tier-1 runs with ``-p no:xdist``): every router
+binds port 0 and every fixture reaps its worker processes in a
+``finally`` — a leaked child would outlive the test process and poison
+the next run's CPU budget.
+"""
+
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.reliability import faults
+from paddle_tpu.serving import (DeadlineExceededError, Router,
+                                RouterClient, RouterShutdownError,
+                                ServerOverloadedError, WorkerFailedError)
+from paddle_tpu.serving import rpc
+
+FC_FEED = {"x": np.full((1, 8), 0.5, "float32")}
+
+
+def _wait_for(cond, timeout=30.0, what="condition"):
+    t0 = time.time()
+    while not cond():
+        assert time.time() - t0 < timeout, "timed out waiting for " + what
+        time.sleep(0.05)
+
+
+def _settled_served(router):
+    """Per-worker served counts once two heartbeat cycles agree —
+    heartbeat-delivered stats lag request completion, so compare settled
+    values, not instantaneous ones."""
+    prev = None
+    t0 = time.time()
+    while time.time() - t0 < 15.0:
+        cur = [w["stats"].get("served", 0)
+               for w in router._worker_states()]
+        if cur == prev:
+            return cur
+        prev = cur
+        time.sleep(max(0.3, 1.5 * router.heartbeat_interval_s))
+    raise AssertionError("worker served counts never settled")
+
+
+# -- rpc framing (in-process, socketpair — no workers needed) ---------------
+
+def _pair():
+    a, b = socket.socketpair()
+    a.settimeout(5.0)
+    b.settimeout(5.0)
+    return a, b
+
+
+def test_rpc_roundtrip_header_and_arrays():
+    a, b = _pair()
+    try:
+        arrays = {"x": np.arange(6, dtype="int64").reshape(2, 3),
+                  "y": np.float32(2.5)}
+        rpc.send_msg(a, {"type": "infer", "deadline_s": 1.5}, arrays)
+        header, got = rpc.recv_msg(b)
+        assert header == {"type": "infer", "deadline_s": 1.5}
+        np.testing.assert_array_equal(got["x"], arrays["x"])
+        assert got["y"] == np.float32(2.5)
+        rpc.send_msg(b, {"type": "result"})  # empty-array frame
+        header, got = rpc.recv_msg(a)
+        assert header == {"type": "result"} and got == {}
+    finally:
+        a.close()
+        b.close()
+
+
+def test_rpc_clean_close_vs_torn_frame():
+    a, b = _pair()
+    a.close()
+    with pytest.raises(rpc.ConnectionClosed):
+        rpc.recv_msg(b)
+    b.close()
+    a, b = _pair()
+    try:
+        a.sendall(b"\x00\x00\x00\x00\x00\x00\x00\x10partial")
+        a.close()
+        with pytest.raises(rpc.RpcError) as ei:
+            rpc.recv_msg(b)
+        assert not isinstance(ei.value, rpc.ConnectionClosed)
+    finally:
+        b.close()
+
+
+def test_rpc_send_fault_site_error_and_corrupt():
+    # error: raises in the SENDER, before any bytes move
+    with faults.fault_scope(faults.FaultPlan.from_spec("rpc.send:error@1")):
+        a, b = _pair()
+        try:
+            with pytest.raises(faults.InjectedFault):
+                rpc.send_msg(a, {"type": "ping"})
+            rpc.send_msg(a, {"type": "ping"})  # invocation 2: clean
+            assert rpc.recv_msg(b)[0] == {"type": "ping"}
+        finally:
+            a.close()
+            b.close()
+    # corrupt: the sender succeeds, the PEER rejects the torn payload
+    with faults.fault_scope(
+            faults.FaultPlan.from_spec("rpc.send:corrupt@1")):
+        a, b = _pair()
+        try:
+            rpc.send_msg(a, {"type": "ping"}, {"x": np.ones(4, "f4")})
+            with pytest.raises(rpc.RpcError):
+                rpc.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+def test_rpc_recv_fault_site_corrupt():
+    with faults.fault_scope(
+            faults.FaultPlan.from_spec("rpc.recv:corrupt@1")):
+        a, b = _pair()
+        try:
+            rpc.send_msg(a, {"type": "ping"}, {"x": np.ones(4, "f4")})
+            with pytest.raises(rpc.RpcError):
+                rpc.recv_msg(b)
+        finally:
+            a.close()
+            b.close()
+
+
+def test_rpc_refuses_insane_length_prefix():
+    a, b = _pair()
+    try:
+        a.sendall((rpc.MAX_FRAME_BYTES + 1).to_bytes(8, "big"))
+        with pytest.raises(rpc.RpcError, match="MAX_FRAME_BYTES"):
+            rpc.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- chaos: heartbeat loss drives a respawn ---------------------------------
+# (runs BEFORE the module-scoped fc_router exists: fault sites are
+# process-global, and a second live router's health loop would consume
+# this plan's worker.heartbeat invocations. Tier-1 runs file order —
+# -p no:randomly.)
+
+def test_router_heartbeat_fault_site_drives_respawn():
+    """worker.heartbeat:error@1-3 fakes three missed pings: each counts
+    heartbeat_misses, the third trips the per-worker breaker, and the
+    (perfectly healthy) process is restarted — proving the loss-of-
+    heartbeat -> respawn path without harming a real worker."""
+    plan = faults.FaultPlan.from_spec("worker.heartbeat:error@1-3")
+    router = Router("builtin:fc", num_workers=1,
+                    heartbeat_interval_s=0.15, max_heartbeat_misses=3,
+                    breaker_threshold=3)
+    try:
+        with faults.fault_scope(plan):
+            router.start()
+            first_pid = router._workers[0].pid
+            _wait_for(lambda: router.metrics_.snapshot()["respawns"] >= 1,
+                      what="heartbeat-driven respawn")
+        snap = router.metrics_.snapshot()
+        assert snap["heartbeat_misses"] == 3
+        assert router._workers[0].pid != first_pid
+        client = RouterClient(router.address)
+        (o,) = client.predict(FC_FEED, timeout_s=60.0)
+        assert o.shape == (1, 4)
+        client.close()
+    finally:
+        router.shutdown()
+
+
+# -- shared 2-worker router (module-scoped: workers cost ~2s each) ----------
+
+@pytest.fixture(scope="module")
+def fc_router():
+    router = Router("builtin:fc", num_workers=2, routing="hash",
+                    heartbeat_interval_s=0.25)
+    try:
+        router.start()
+        client = RouterClient(router.address, pool_size=8,
+                              default_timeout_s=60.0)
+        # warm both workers so later tests measure steady state
+        for _ in range(4):
+            client.predict(FC_FEED)
+        yield router, client
+        client.close()
+    finally:
+        router.shutdown()
+
+
+def test_router_predict_and_async_submit(fc_router):
+    router, client = fc_router
+    out, = client.predict({"x": np.full((3, 8), 0.25, "float32")})
+    assert out.shape == (3, 4)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+    futs = [client.submit(FC_FEED) for _ in range(10)]
+    for f in futs:
+        (o,) = f.result(60.0)
+        assert o.shape == (1, 4)
+
+
+def test_router_metrics_shape_and_worker_states(fc_router):
+    router, client = fc_router
+    m = client.metrics()
+    snap = m["snapshot"]
+    for key in ("door_shed", "rerouted", "respawns", "heartbeat_misses",
+                "deadline_refused", "requests_completed", "latency_s"):
+        assert key in snap
+    assert snap["requests_completed"] >= 4
+    assert len(m["workers"]) == 2
+    for w in m["workers"]:
+        assert w["healthy"] and w["breaker"] == "closed"
+        assert isinstance(w["pid"], int)
+    # the heartbeat actually delivers engine stats
+    _wait_for(lambda: all("served" in w["stats"]
+                          for w in client.metrics()["workers"]),
+              what="heartbeat stats")
+
+
+def test_router_hash_routing_is_sticky(fc_router):
+    router, client = fc_router
+    # same key -> same worker, every time (consistent-hash ring)
+    order = router._hash_order("session-abc")
+    for _ in range(6):
+        client.predict(FC_FEED, key="session-abc")
+    assert router._hash_order("session-abc") == order
+    states = {w["index"]: w for w in client.metrics()["workers"]}
+    preferred = states[order[0]]
+    _wait_for(lambda: {w["index"]: w for w in client.metrics()
+                       ["workers"]}[order[0]]["stats"]
+              .get("served", 0) >= 6, what="sticky worker served count")
+    assert preferred["healthy"]
+
+
+def test_router_dispatch_fault_takes_one_retry(fc_router):
+    router, client = fc_router
+    before = router.metrics_.snapshot()
+    plan = faults.FaultPlan.from_spec("router.dispatch:error@1")
+    with faults.fault_scope(plan):
+        out, = client.predict(FC_FEED)
+    assert out.shape == (1, 4)
+    after = router.metrics_.snapshot()
+    # hop 1 failed in the router; the single cross-worker retry served it
+    assert after["rerouted"] >= before["rerouted"] + 1
+    assert after["requests_completed"] == before["requests_completed"] + 1
+
+
+def test_router_deadline_expiring_in_router_refused_at_worker(fc_router):
+    """THE deadline-propagation proof: burn the budget INSIDE the router
+    (injected dispatch hang), and the worker — not the router — refuses
+    the request without executing it, counted in deadline_refused."""
+    router, client = fc_router
+    before = router.metrics_.snapshot()
+    w_served = _settled_served(router)
+    plan = faults.FaultPlan.from_spec("router.dispatch:hang(0.4)@1")
+    with faults.fault_scope(plan):
+        with pytest.raises(DeadlineExceededError) as ei:
+            client.predict(FC_FEED, timeout_s=0.15)
+    assert ei.value.kind == "DeadlineRefused"
+    after = router.metrics_.snapshot()
+    assert after["deadline_refused"] == before["deadline_refused"] + 1
+    # the worker refused WITHOUT executing: nobody's served count moved
+    assert _settled_served(router) == w_served
+
+
+def test_router_client_close_then_submit_raises(fc_router):
+    router, _ = fc_router
+    c = RouterClient(router.address)
+    c.close()
+    with pytest.raises(RouterShutdownError):
+        c.submit(FC_FEED)
+
+
+# -- overload + EDF door shedding (dedicated slow-tier router) --------------
+
+def test_router_door_overload_edf_shed_and_typed_rejection():
+    """One worker whose every batch hangs 0.3s, a 2-deep door: the third
+    concurrent request EDF-sheds the WAITING one with the later
+    deadline; a fourth with the latest deadline gets the typed
+    rejection. Nothing hangs, nothing is lost silently."""
+    router = Router(
+        "builtin:fc", num_workers=1, max_queue_depth=2,
+        inflight_per_worker=1, heartbeat_interval_s=10.0,
+        queue_wait_timeout_s=20.0,
+        worker_env={"PADDLE_TPU_FAULTS": "predictor.run:hang(0.3)@1-99"})
+    try:
+        router.start()
+        client = RouterClient(router.address, pool_size=8)
+        f1 = client.submit(FC_FEED, timeout_s=60.0)
+        _wait_for(lambda: router._dispatched == 1, what="f1 dispatched")
+        f2 = client.submit(FC_FEED, timeout_s=50.0)
+        _wait_for(lambda: len(router._entries) == 1, what="f2 waiting")
+        # earlier deadline than f2 -> displaces it (EDF at the door)
+        f3 = client.submit(FC_FEED, timeout_s=10.0)
+        with pytest.raises(ServerOverloadedError):
+            f2.result(30.0)
+        assert len(f1.result(60.0)) == 1
+        assert len(f3.result(60.0)) == 1
+        snap = router.metrics_.snapshot()
+        assert snap["door_shed"] == 1
+        # door full of EARLIER deadlines -> a later arrival is rejected,
+        # not queued unboundedly
+        g1 = client.submit(FC_FEED, timeout_s=40.0)
+        _wait_for(lambda: router._dispatched
+                  + len(router._entries) >= 1, what="g1 admitted")
+        results, errors = [], []
+        for f in [client.submit(FC_FEED, timeout_s=30.0)
+                  for _ in range(6)] + [g1]:
+            try:
+                results.append(f.result(60.0))
+            except (ServerOverloadedError, DeadlineExceededError) as e:
+                errors.append(e)
+        assert len(results) + len(errors) == 7  # every future resolved
+        assert router.metrics_.snapshot()["requests_rejected"] >= 1
+        client.close()
+    finally:
+        router.shutdown()
+
+
+# -- chaos: SIGKILL mid-request, heartbeat loss, respawn --------------------
+
+def test_router_sigkill_worker_mid_request_zero_silent_loss():
+    """The acceptance drill: SIGKILL one of two workers while a burst is
+    in flight. Every accepted request must end in a result or a typed
+    error (no hangs), the dead worker must respawn on the RetryPolicy
+    schedule, and the fleet must serve afterwards."""
+    router = Router("builtin:fc", num_workers=2,
+                    heartbeat_interval_s=0.2)
+    try:
+        router.start()
+        client = RouterClient(router.address, pool_size=8)
+        for _ in range(4):
+            client.predict(FC_FEED, timeout_s=60.0)
+        victim_pid = router._workers[0].pid
+        futs = [client.submit(FC_FEED, timeout_s=60.0)
+                for _ in range(12)]
+        os.kill(victim_pid, signal.SIGKILL)
+        resolved = typed = 0
+        for f in futs:
+            try:
+                (o,) = f.result(60.0)
+                assert o.shape == (1, 4)
+                resolved += 1
+            except (WorkerFailedError, ServerOverloadedError,
+                    DeadlineExceededError):
+                typed += 1
+        assert resolved + typed == 12  # zero silent losses
+        assert resolved >= 1  # the surviving worker kept serving
+        _wait_for(lambda: router.metrics_.snapshot()["respawns"] >= 1
+                  and all(w["healthy"]
+                          for w in router._worker_states()),
+                  what="respawn")
+        assert router._workers[0].pid != victim_pid
+        (o,) = client.predict(FC_FEED, timeout_s=60.0)
+        assert o.shape == (1, 4)  # post-recovery, full fleet again
+        client.close()
+    finally:
+        router.shutdown()
+
+
+# -- model-agnosticism: the MT greedy decoder through the same door ---------
+
+def test_router_serves_machine_translation_greedy_infer():
+    router = Router("builtin:mt_greedy", num_workers=1,
+                    heartbeat_interval_s=0.5)
+    try:
+        router.start()
+        client = RouterClient(router.address)
+        src = (np.arange(6, dtype="int64") % 32)[None, :]
+        ids, scores = client.predict(
+            {"src_ids": src, "src_len": np.array([6], "int64")},
+            timeout_s=120.0, key="mt-session")
+        assert ids.shape[0] == 1 and ids.shape[1] >= 1
+        assert scores.shape == (1,)
+        client.close()
+    finally:
+        router.shutdown()
+
+
+# -- soak (excluded from tier-1) --------------------------------------------
+
+@pytest.mark.slow
+def test_router_soak_kill_respawn_under_sustained_load():
+    """Multi-process soak: sustained load with a SIGKILL every ~2s;
+    after each kill the fleet recovers and the accepted-request ledger
+    stays silent-loss-free throughout."""
+    router = Router("builtin:fc", num_workers=2,
+                    heartbeat_interval_s=0.2)
+    try:
+        router.start()
+        client = RouterClient(router.address, pool_size=8)
+        stop = threading.Event()
+        resolved, typed = [], []
+
+        def load():
+            while not stop.is_set():
+                try:
+                    client.predict(FC_FEED, timeout_s=30.0)
+                    resolved.append(1)
+                except (WorkerFailedError, ServerOverloadedError,
+                        DeadlineExceededError):
+                    typed.append(1)
+                except RouterShutdownError:
+                    return
+
+        threads = [threading.Thread(target=load) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for round_no in range(3):
+            time.sleep(2.0)
+            os.kill(router._workers[round_no % 2].pid, signal.SIGKILL)
+            _wait_for(lambda: all(w["healthy"]
+                                  for w in router._worker_states()),
+                      timeout=60.0, what="soak respawn")
+        stop.set()
+        for t in threads:
+            t.join(30.0)
+            assert not t.is_alive()
+        assert len(resolved) > 0
+        assert router.metrics_.snapshot()["respawns"] >= 3
+        client.close()
+    finally:
+        router.shutdown()

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Chaos drill for the multi-process serving front door (ISSUE 16).
+
+Stands up a router + N worker processes, drives a burst of concurrent
+requests, optionally SIGKILLs a worker mid-flight, and audits the
+accepted-request ledger: every request must end in a result or a TYPED
+error within its bound. A request that does neither is a **silent
+loss** — the one failure mode the router is not allowed to have — and
+makes this tool exit nonzero.
+
+    python tools/chaos_router.py --workers 2 --requests 24 --kill
+    python tools/chaos_router.py --smoke     # lint.sh gate: 1 worker,
+                                             # 8 requests, no kill
+
+Prints one JSON summary line (counters + verdict) so CI logs stay
+greppable. ``--faults`` forwards a ``PADDLE_TPU_FAULTS`` plan to every
+worker process (e.g. ``predictor.run:error@2``) for wire-level drills.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="chaos_router", description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--model", default="builtin:fc")
+    ap.add_argument("--kill", action="store_true",
+                    help="SIGKILL one worker while the burst is in "
+                         "flight, then require a respawn")
+    ap.add_argument("--faults", default=None,
+                    help="PADDLE_TPU_FAULTS plan injected into every "
+                         "worker process")
+    ap.add_argument("--timeout-s", type=float, default=60.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI preset: 1 worker, 8 requests, no kill")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.workers, args.requests, args.kill = 1, 8, False
+
+    import numpy as np
+
+    from paddle_tpu.serving import (DeadlineExceededError, Router,
+                                    RouterClient, RouterShutdownError,
+                                    ServerOverloadedError,
+                                    WorkerFailedError)
+
+    worker_env = {}
+    if args.faults:
+        worker_env["PADDLE_TPU_FAULTS"] = args.faults
+    router = Router(args.model, num_workers=args.workers,
+                    heartbeat_interval_s=0.2, worker_env=worker_env)
+    feed = {"x": np.full((1, 8), 0.5, "float32")}
+    summary = {"workers": args.workers, "requests": args.requests,
+               "kill": bool(args.kill), "faults": args.faults,
+               "accepted": 0, "completed": 0, "typed_errors": {},
+               "silent_losses": 0, "respawns": 0, "recovered": None}
+    try:
+        router.start()
+        client = RouterClient(router.address, pool_size=8)
+        client.predict(feed, timeout_s=args.timeout_s)  # warm the fleet
+        futs = [client.submit(feed, timeout_s=args.timeout_s)
+                for _ in range(args.requests)]
+        summary["accepted"] = len(futs)
+        if args.kill:
+            os.kill(router._workers[0].pid, signal.SIGKILL)
+        for f in futs:
+            try:
+                f.result(args.timeout_s + 30.0)
+                summary["completed"] += 1
+            except (WorkerFailedError, ServerOverloadedError,
+                    DeadlineExceededError, RouterShutdownError) as e:
+                kind = type(e).__name__
+                summary["typed_errors"][kind] = \
+                    summary["typed_errors"].get(kind, 0) + 1
+            except Exception:
+                # an untyped resolution (incl. the drain-timeout above)
+                # counts as a silent loss: callers can't act on it
+                summary["silent_losses"] += 1
+        if args.kill:
+            t0 = time.time()
+            while time.time() - t0 < 60.0:
+                snap = router.metrics_.snapshot()
+                if snap["respawns"] >= 1 and all(
+                        w["healthy"] for w in router._worker_states()):
+                    break
+                time.sleep(0.2)
+            try:
+                client.predict(feed, timeout_s=args.timeout_s)
+                summary["recovered"] = True
+            except Exception:
+                summary["recovered"] = False
+        summary["respawns"] = router.metrics_.snapshot()["respawns"]
+        client.close()
+    finally:
+        router.shutdown()
+
+    ok = (summary["silent_losses"] == 0 and summary["completed"] > 0
+          and summary["recovered"] is not False)
+    summary["verdict"] = "ok" if ok else "FAIL"
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

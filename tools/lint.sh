@@ -11,6 +11,10 @@
 #      --zoo`, which since ISSUE 15 also runs the COST pass over every
 #      zoo program) — the verifier's regression corpus must stay at zero
 #      findings and every cost rule must run without crashing.
+#   3. the router chaos smoke (`tools/chaos_router.py --smoke`, ISSUE
+#      16): one real worker process behind the socket front door, a
+#      small burst, zero silent losses — the multi-process serving path
+#      must stay standing before anything ships.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -27,4 +31,7 @@ else
 fi
 
 JAX_PLATFORMS=cpu "$PY" -m paddle_tpu.analysis --zoo -q
+
+JAX_PLATFORMS=cpu "$PY" tools/chaos_router.py --smoke
+
 echo "lint.sh: ok"
