@@ -8,7 +8,12 @@ transformer-base last (the flagship). Select a single config with
 seq2048|serving|all``; ``--dygraph`` routes bert through the dygraph
 build).
 
-Each line: {"metric", "value", "unit", "vs_baseline"}. ``vs_baseline``
+Each line: {"metric", "value", "unit", "vs_baseline", "obs"}. ``obs``
+carries the record's telemetry view (ISSUE 17): whether the measured
+loop ran under ``paddle_tpu.obs.trace`` (``BENCH_TRACE=1`` turns it on
+and the field then points at the ``trace-<pid>.jsonl`` capture for
+``tools/trace_view.py``), the span count the config contributed, and
+the live MFU gauge's roofline-vs-measured agreement. ``vs_baseline``
 is model FLOPs utilization (MFU) relative to the BASELINE.json
 north-star target of 45% MFU (>1.0 beats the target); for the
 row-latency-bound DeepFM config it is throughput vs 45% of the
@@ -96,6 +101,50 @@ def _static_model(program, batch, amp):
         return {"error": "%s: %s" % (type(e).__name__, e)}
 
 
+def _obs_begin():
+    """Open one config's telemetry window (ISSUE 17). Under
+    ``BENCH_TRACE=1`` the process tracer is started (once) with its
+    capture directed at ``BENCH_TRACE_DIR`` or a fresh temp dir, so the
+    measured loop's executor/engine spans land in a ``trace-<pid>.jsonl``
+    the record can point at. The MFU gauge is reset either way so the
+    record's ``mfu_vs_model`` covers exactly this config's steps.
+    Returns the span mark ``_obs_record`` subtracts."""
+    from paddle_tpu.obs import trace
+    from paddle_tpu.obs.registry import MFU
+
+    if os.environ.get("BENCH_TRACE") == "1" and trace.active() is None:
+        import tempfile
+
+        trace_dir = (os.environ.get("BENCH_TRACE_DIR")
+                     or tempfile.mkdtemp(prefix="paddle-tpu-bench-trace-"))
+        trace.start(trace_dir=trace_dir)
+    MFU.reset()
+    tracer = trace.active()
+    return len(tracer.spans) + tracer.dropped if tracer else 0
+
+
+def _obs_record(mark=0):
+    """The record's ``obs`` field: whether the measured loop ran under
+    tracing, where the capture landed (feed it to tools/trace_view.py),
+    how many spans this config contributed, and the live MFU gauge's
+    model-agreement figure from ``Executor.run`` (None when untraced —
+    the gauge only fills under tracing, where the executor blocks on the
+    fetch for an honest step time)."""
+    from paddle_tpu.obs import trace
+    from paddle_tpu.obs.registry import MFU
+
+    snap = MFU.snapshot()
+    obs = {"traced": trace.active() is not None,
+           "trace_path": None, "span_count": 0,
+           "mfu_vs_model": snap.get("mfu_vs_model")}
+    tracer = trace.active()
+    if tracer is not None:
+        trace.flush()
+        obs["trace_path"] = tracer.path()
+        obs["span_count"] = len(tracer.spans) + tracer.dropped - mark
+    return obs
+
+
 def _build(model, on_tpu, seq_override=None):
     """Returns (spec, batch, metric_name, unit, per_example, seq_len).
     ``seq_len`` is None for the non-sequence configs."""
@@ -171,6 +220,7 @@ def _bench_static(model, on_tpu, seq_override=None):
     import jax
     import paddle_tpu as fluid
 
+    obs_mark = _obs_begin()
     main_prog, startup = fluid.Program(), fluid.Program()
     amp_on = os.environ.get("BENCH_AMP", "1") == "1"
     with fluid.program_guard(main_prog, startup):
@@ -294,7 +344,8 @@ def _bench_static(model, on_tpu, seq_override=None):
                 seq_len, seq_len, 512, 2 if amp_on else 4, 8,
                 dropout=0.1))
     return {"metric": metric, "value": round(examples_per_sec, 1),
-            "unit": unit, "vs_baseline": round(vsb, 4), "config": config}
+            "unit": unit, "vs_baseline": round(vsb, 4), "config": config,
+            "obs": _obs_record(obs_mark)}
 
 
 def _poisson_sweep(eng, rates, requests_per_rate, p99_budget_s, rng):
@@ -686,6 +737,7 @@ def _bench_serving(on_tpu):
     import paddle_tpu as fluid
     from paddle_tpu import serving
 
+    obs_mark = _obs_begin()
     requests_per_rate = int(os.environ.get("BENCH_SERVING_REQUESTS",
                                            500 if on_tpu else 120))
     replicas = int(os.environ.get("BENCH_SERVING_REPLICAS", 2))
@@ -767,7 +819,8 @@ def _bench_serving(on_tpu):
                 "requests_shed": m["requests_shed"],
                 "requests_retried": m["requests_retried"],
                 "replicas_evicted": m["replicas_evicted"],
-                "workers_respawned": m["workers_respawned"]}}
+                "workers_respawned": m["workers_respawned"]},
+            "obs": _obs_record(obs_mark)}
 
 
 def _bench_bert_dygraph(on_tpu):
@@ -776,6 +829,7 @@ def _bench_bert_dygraph(on_tpu):
     import jax
     from paddle_tpu.models import bert_dygraph
 
+    obs_mark = _obs_begin()
     amp = os.environ.get("BENCH_AMP", "1") == "1"
     if on_tpu:
         cfg = dict(seq_len=128, amp=amp)
@@ -819,6 +873,7 @@ def _bench_bert_dygraph(on_tpu):
                    "steps": steps, "amp": amp,
                    "peak_flops": _peak_flops(jax.devices()[0]),
                    "flops_per_example": flops_per_example},
+        "obs": _obs_record(obs_mark),
     }
 
 

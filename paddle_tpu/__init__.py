@@ -50,6 +50,7 @@ from . import dygraph  # noqa: F401
 from . import data  # noqa: F401
 from .data.feeder import DataFeeder  # noqa: F401
 from . import profiler  # noqa: F401
+from . import obs  # noqa: F401
 from . import debugger  # noqa: F401
 from . import analysis  # noqa: F401
 from . import dlpack  # noqa: F401

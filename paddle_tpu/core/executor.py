@@ -29,6 +29,8 @@ import numpy as np
 from . import framework
 from .framework import Variable
 from .op_registry import run_op, RNG_KEY, RNG0_KEY, ENV0_KEY
+from ..obs import registry as obs_registry
+from ..obs import trace as obs_trace
 
 __all__ = ["Executor", "Scope", "global_scope", "scope_guard",
            "XLAPlace", "TPUPlace", "CPUPlace", "CUDAPlace"]
@@ -267,6 +269,9 @@ class Executor:
         # 2 = raising). A warn-mode pass must NOT suppress a later strict
         # verify=True of the same variant.
         self._verified = {}
+        # per-variant static roofline estimates feeding the live MFU
+        # gauge (obs.registry.MFU) when a step runs under tracing
+        self._mfu_cache = {}
 
     # -- public API ---------------------------------------------------------
     def run(self, program=None, feed=None, fetch_list=None, scope=None,
@@ -460,13 +465,52 @@ class Executor:
         self._last_call = (jfn, jax.tree.map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
             if hasattr(a, "shape") else a, (state, feed_arrays, rng)))
-        fetches, new_state, rng_out = jfn(state, feed_arrays, rng)
+        sp = obs_trace.span("executor.run")
+        if sp:
+            # under tracing the step is timed honestly: block on the
+            # fetches so async dispatch can't hide device time, then feed
+            # the measured wall next to the static roofline (MFU gauge)
+            roof = self._static_roofline(key, program, feed_arrays)
+            with sp:
+                fetches, new_state, rng_out = jfn(state, feed_arrays, rng)
+                jax.block_until_ready(fetches)
+                if roof is not None:
+                    sp.set(roofline_s=roof.get("roofline_s"),
+                           bound=roof.get("bound"))
+            if roof is not None:
+                obs_registry.MFU.record(sp.duration, roof)
+        else:
+            fetches, new_state, rng_out = jfn(state, feed_arrays, rng)
         scope.set(RNG_KEY, rng_out)
         for n, v in new_state.items():
             scope.set(n, v)
         if return_numpy:
             return [np.asarray(f) for f in fetches]
         return list(fetches)
+
+    def _static_roofline(self, key, program, feed_arrays):
+        """Cached ``analysis/cost.py`` roofline for this compiled
+        variant — priced ONCE per cache key, then a dict lookup per
+        step. Returns None for programs the cost engine can't price
+        (never an error: the gauge is advisory)."""
+        if key in self._mfu_cache:
+            return self._mfu_cache[key]
+        roof = None
+        try:
+            from ..analysis.cost import estimate_program
+
+            batch = None
+            for a in feed_arrays.values():
+                if getattr(a, "ndim", 0) >= 1:
+                    batch = int(a.shape[0])
+                    break
+            est = estimate_program(program, batch=batch,
+                                   feed_names=sorted(feed_arrays))
+            roof = est.roofline()
+        except Exception:
+            roof = None
+        self._mfu_cache[key] = roof
+        return roof
 
     def lowered_hlo_text(self):
         """Optimized HLO text of the step this executor LAST ran —
@@ -484,6 +528,7 @@ class Executor:
         compiled-program cache."""
         self._cache.clear()
         self._verified.clear()
+        self._mfu_cache.clear()
         self._last_call = None
 
     # -- debug run-mode -----------------------------------------------------

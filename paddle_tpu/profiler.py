@@ -17,6 +17,9 @@ __all__ = ["profiler", "start_profiler", "stop_profiler", "reset_profiler",
            "record_event", "Histogram"]
 
 _events = defaultdict(lambda: [0.0, 0])  # name -> [total_s, count]
+# serving threads record events concurrently with a sampling stop/report
+# on another thread; every _events touch goes through this lock
+_events_lock = threading.Lock()
 _trace_dir = None
 _enabled = False
 
@@ -47,13 +50,15 @@ def stop_profiler(sorted_key="total", profile_path=None, silent=False):
 
 
 def reset_profiler():
-    _events.clear()
+    with _events_lock:
+        _events.clear()
 
 
 def _report(sorted_key="total"):
     lines = ["%-40s %10s %12s %12s" % ("Event", "Calls", "Total(ms)",
                                        "Avg(ms)")]
-    items = list(_events.items())
+    with _events_lock:
+        items = [(name, list(v)) for name, v in _events.items()]
     if sorted_key == "total":
         items.sort(key=lambda kv: -kv[1][0])
     elif sorted_key == "calls":
@@ -75,9 +80,11 @@ def record_event(name):
             yield
     finally:
         if _enabled:
-            ev = _events[name]
-            ev[0] += time.perf_counter() - t0
-            ev[1] += 1
+            dt = time.perf_counter() - t0
+            with _events_lock:
+                ev = _events[name]
+                ev[0] += dt
+                ev[1] += 1
 
 
 @contextlib.contextmanager

@@ -191,6 +191,10 @@ class FaultPlan:
             kind, hang_s = self._decide_locked(site, inv)
         if kind is None:
             return None
+        # only DECISIONS reach the flight ring — the no-fault path above
+        # stays lock+dict-increment only
+        from ..obs import flight as _flight
+        _flight.record("fault.trip", site=site, invocation=inv, fault=kind)
         if kind == "error":
             raise InjectedFault(site, inv)
         if kind == "hang":

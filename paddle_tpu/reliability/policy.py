@@ -17,6 +17,8 @@ postmortem.
 import random
 import time
 
+from ..obs import flight
+
 __all__ = ["RetryPolicy", "RetryError", "CircuitBreaker", "Deadline",
            "DeadlineExpired"]
 
@@ -120,12 +122,13 @@ class CircuitBreaker:
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
     def __init__(self, failure_threshold=3, reset_timeout_s=30.0,
-                 clock=None):
+                 clock=None, name=None):
         if failure_threshold < 1:
             raise ValueError("failure_threshold must be >= 1")
         self.failure_threshold = int(failure_threshold)
         self.reset_timeout_s = float(reset_timeout_s)
         self.clock = clock or time.monotonic
+        self.name = name  # flight-recorder label; anonymous if None
         self.state = self.CLOSED
         self.consecutive_failures = 0
         self.opened_at = None
@@ -141,6 +144,8 @@ class CircuitBreaker:
         return True
 
     def record_success(self):
+        if self.state != self.CLOSED:
+            flight.record("breaker.close", breaker=self.name)
         self.consecutive_failures = 0
         self.state = self.CLOSED
         self.opened_at = None
@@ -154,7 +159,11 @@ class CircuitBreaker:
                 or self.consecutive_failures >= self.failure_threshold):
             self.state = self.OPEN
             self.opened_at = self.clock()
-            return not was_open
+            if not was_open:
+                flight.record("breaker.open", breaker=self.name,
+                              failures=self.consecutive_failures)
+                return True
+            return False
         return False
 
     def reset(self):

@@ -25,9 +25,12 @@ class Request:
     leading batch dim), its example count ``n``, the caller's ``future``,
     the admission timestamps the deadline checks read, and ``retries``
     (how many times a failed batch has re-enqueued it — the engine's
-    cross-replica retry budget)."""
+    cross-replica retry budget). ``trace_ctx`` carries the submitter's
+    (trace_id, span_id) across the queue so the batch-serving thread can
+    parent its span onto the request's trace (``obs/trace.py``)."""
 
-    __slots__ = ("feed", "n", "future", "enqueue_t", "deadline", "retries")
+    __slots__ = ("feed", "n", "future", "enqueue_t", "deadline", "retries",
+                 "trace_ctx")
 
     def __init__(self, feed, n, future, enqueue_t, deadline=None):
         self.feed = feed
@@ -36,6 +39,7 @@ class Request:
         self.enqueue_t = enqueue_t
         self.deadline = deadline
         self.retries = 0
+        self.trace_ctx = None
 
 
 class DynamicBatcher:

@@ -48,6 +48,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from ..obs import trace
 from .admission import AdmissionController, DeadlineExceededError
 from .buckets import bucket_for, pow2_ladder
 from .engine import EngineShutdownError
@@ -468,6 +469,10 @@ class DecodeBatcher:
         return feed
 
     def _step_once(self):
+        with trace.span("decode.step") as sp:
+            self._step_once_traced(sp)
+
+    def _step_once_traced(self, sp):
         b, c = self._bucket
         toks = np.zeros((b,), np.int64)
         pos = np.zeros((b,), np.int32)
@@ -513,6 +518,9 @@ class DecodeBatcher:
             else:
                 slot.next_token = nxt
         self.metrics_.observe_decode_step(live, b, generated)
+        if sp:
+            # slot occupancy rides on every step span (ISSUE 17)
+            sp.set(live=live, bucket=b, ctx=c, generated=generated)
 
     def _retire(self, i, slot, now):
         """Finished sequence: resolve, free the slot IMMEDIATELY (the

@@ -36,6 +36,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..inference import AnalysisConfig, Predictor
+from ..obs import flight, trace
 from ..reliability import faults
 from ..reliability.policy import CircuitBreaker
 from .admission import (AdmissionController, DeadlineExceededError,
@@ -419,7 +420,9 @@ class ServingEngine:
                     "shed under overload: the slot went to a request "
                     "with an earlier deadline"))
                 self.metrics_.observe_shed()
+                flight.record("edf.shed", where="engine", n=victim.n)
         req = Request(feed, n, Future(), now, deadline=deadline)
+        req.trace_ctx = trace.current()
         try:
             self._batcher.put(req)
         except RuntimeError:
@@ -585,6 +588,7 @@ class ServingEngine:
             if w.thread is not None and not w.thread.is_alive():
                 self._spawn_worker_thread(w)
                 self.metrics_.observe_respawned()
+                flight.record("thread.respawn", where="engine", replica=w.index)
                 return True
         return False
 
@@ -638,6 +642,7 @@ class ServingEngine:
             worker.predictor = fresh
             worker.seen_signatures = set()
             self.metrics_.observe_evicted()
+            flight.record("replica.evict", where="engine", replica=worker.index)
         worker.breaker.reset()
 
     def _serve_batch(self, worker, batch):
@@ -700,12 +705,23 @@ class ServingEngine:
         # phase 2 — dispatch. Failures here are REPLICA faults: they
         # count on the breaker (evict+rebuild on trip) and the batch's
         # requests get their one cross-replica retry
+        # parent the batch span onto the first live request's trace so a
+        # propagated router trace stitches through the queue hand-off; the
+        # predictor.run below reaches Executor.run on this same thread, so
+        # the executor span nests here by ambient context
+        sp = trace.span("engine.batch",
+                        parent=next((r.trace_ctx for r in live
+                                     if r.trace_ctx is not None), None))
         try:
             sig = self._signature(padded)
             hit = sig in worker.seen_signatures
             worker.seen_signatures.add(sig)
             faults.trip("predictor.run")
-            outs = worker.predictor.run(padded)
+            with sp:
+                if sp:  # tags must land before the span closes
+                    sp.set(n=n, rung=rung, requests=len(live),
+                           replica=worker.index)
+                outs = worker.predictor.run(padded)
             outs = unpad_fetch(outs, n, padded_to=rung)
         except Exception as e:
             # fail only this batch; the replica (and its clone-shared

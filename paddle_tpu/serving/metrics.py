@@ -1,22 +1,64 @@
-"""Serving observability, built on ``paddle_tpu.profiler``.
+"""Serving observability, built on ``paddle_tpu.obs.registry``.
 
 What a serving operator actually pages on: the latency tail (p50/p95/p99
 via ``profiler.Histogram``'s sliding window), queue depth, batch occupancy
 (real examples / bucket slots — the padding tax the ladder charges for a
 bounded compile cache), and the compile-cache hit rate (misses after
-warm-up mean a shape leaked past the bucketing). Exposed both as a plain
-dict (``snapshot``) and a formatted table shaped like ``profiler._report``.
+warm-up mean a shape leaked past the bucketing). Exposed three ways:
+
+  * ``snapshot()`` — the plain dict the bench harness and tests pin
+    (field names are a CONTRACT with ``tests/test_bench_contract.py``;
+    do not rename);
+  * ``report()`` — a formatted table shaped like ``profiler._report``;
+  * ``prometheus_text()`` — the registry's Prometheus exposition (every
+    counter under ``paddle_tpu_serving_*``, gauges, latency summaries)
+    plus the live MFU gauge, served from the router's ping path and the
+    worker ``stats`` verb.
+
+Every counter is a named :class:`~paddle_tpu.obs.registry.Counter` in a
+per-instance :class:`~paddle_tpu.obs.registry.Registry` — the observe_*
+API and snapshot shape are unchanged from the pre-registry version.
 """
 
-import threading
-
+from ..obs.registry import Registry
+from ..obs.registry import MFU as _MFU
 from ..profiler import Histogram
 
 __all__ = ["ServingMetrics"]
 
+# snapshot field -> Prometheus help string; the registry metric name is
+# paddle_tpu_serving_<field>. Order here is the exposition order.
+_COUNTERS = (
+    ("requests_completed", "requests answered with a result"),
+    ("requests_failed", "requests answered with a non-deadline error"),
+    ("requests_rejected", "requests refused at admission (no shed victim)"),
+    ("requests_expired", "requests whose deadline passed before serving"),
+    ("requests_shed", "queued requests displaced by EDF shedding"),
+    ("requests_retried", "requests re-enqueued after a failed batch"),
+    ("replicas_evicted", "replica predictors evicted and rebuilt"),
+    ("workers_respawned", "dead engine worker threads restarted"),
+    ("door_shed", "requests displaced at the router door (EDF)"),
+    ("rerouted", "requests sent to a non-first-choice worker"),
+    ("respawns", "worker processes restarted by the router"),
+    ("heartbeat_misses", "worker heartbeat probes that failed"),
+    ("deadline_refused", "expired requests refused by a worker"),
+    ("batches", "micro-batches dispatched"),
+    ("batched_examples", "real examples across all dispatched batches"),
+    ("bucket_slots", "padded slots across all dispatched batches"),
+    ("compile_cache_hits", "dispatches on an already-seen signature"),
+    ("compile_cache_misses", "dispatches that compiled a new signature"),
+    ("decode_steps", "continuous-batching decode loop passes"),
+    ("decode_tokens", "tokens sampled by the decode loop"),
+    ("slot_live", "occupied slots summed over decode steps"),
+    ("slot_total", "total slots summed over decode steps"),
+)
+
+_PREFIX = "paddle_tpu_serving_"
+
 
 class ServingMetrics:
     def __init__(self, latency_window=8192):
+        self.registry = Registry()
         self.latency = Histogram(max_samples=latency_window)
         # decode-tier tails (continuous batcher): time-to-first-token and
         # time-per-output-token — THE serving-latency pair for
@@ -24,34 +66,24 @@ class ServingMetrics:
         # queueing vs generation is slow)
         self.ttft = Histogram(max_samples=latency_window)
         self.tpot = Histogram(max_samples=latency_window)
-        self._lock = threading.Lock()
-        self._decode_steps = 0
-        self._decode_tokens = 0
-        self._slot_live = 0
-        self._slot_total = 0
-        self._completed = 0
-        self._failed = 0
-        self._rejected = 0
-        self._expired = 0
-        self._shed = 0
-        self._retried = 0
-        self._evicted = 0
-        self._respawned = 0
-        # router-tier counters (multi-process front door, ISSUE 16):
-        # the in-process counters above count what one engine did; these
-        # count what the DOOR did across workers
-        self._door_shed = 0
-        self._rerouted = 0
-        self._respawns = 0
-        self._heartbeat_misses = 0
-        self._deadline_refused = 0
-        self._batches = 0
-        self._batched_examples = 0
-        self._bucket_slots = 0
-        self._cache_hits = 0
-        self._cache_misses = 0
+        self._c = {}
+        for field, help_text in _COUNTERS:
+            self._c[field] = self.registry.counter(_PREFIX + field,
+                                                   help=help_text)
         self._queue_depth_fn = lambda: 0
         self._in_flight_fn = lambda: 0
+        self.registry.gauge(_PREFIX + "queue_depth",
+                            help="examples queued, not yet in a batch",
+                            fn=lambda: self._queue_depth_fn())
+        self.registry.gauge(_PREFIX + "in_flight",
+                            help="admitted examples not yet resolved",
+                            fn=lambda: self._in_flight_fn())
+        self.registry.histogram(_PREFIX + "latency_seconds", self.latency,
+                                help="request latency (sliding window)")
+        self.registry.histogram(_PREFIX + "ttft_seconds", self.ttft,
+                                help="time to first sampled token")
+        self.registry.histogram(_PREFIX + "tpot_seconds", self.tpot,
+                                help="time per output token after the first")
 
     # -- wiring (the engine hands us its live gauges) -----------------------
     def bind_gauges(self, queue_depth_fn, in_flight_fn):
@@ -61,84 +93,70 @@ class ServingMetrics:
     # -- observation points -------------------------------------------------
     def observe_completed(self, latency_s):
         self.latency.add(latency_s)
-        with self._lock:
-            self._completed += 1
+        self._c["requests_completed"].inc()
 
     def observe_failed(self, n=1):
-        with self._lock:
-            self._failed += n
+        self._c["requests_failed"].inc(n)
 
     def observe_rejected(self, n=1):
-        with self._lock:
-            self._rejected += n
+        self._c["requests_rejected"].inc(n)
 
     def observe_expired(self, n=1):
-        with self._lock:
-            self._expired += n
+        self._c["requests_expired"].inc(n)
 
     def observe_shed(self, n=1):
         """An admitted request displaced under overload by a new arrival
         with an earlier deadline (EDF shedding)."""
-        with self._lock:
-            self._shed += n
+        self._c["requests_shed"].inc(n)
 
     def observe_retried(self, n=1):
         """A request re-enqueued after its batch failed (cross-replica
         retry); it will also count completed/failed when it resolves."""
-        with self._lock:
-            self._retried += n
+        self._c["requests_retried"].inc(n)
 
     def observe_evicted(self):
         """A replica's circuit breaker tripped: predictor evicted and
         rebuilt from the parent."""
-        with self._lock:
-            self._evicted += 1
+        self._c["replicas_evicted"].inc()
 
     def observe_respawned(self):
         """The supervisor found a dead worker thread and restarted it."""
-        with self._lock:
-            self._respawned += 1
+        self._c["workers_respawned"].inc()
 
     def observe_door_shed(self, n=1):
         """An admitted request displaced AT THE ROUTER DOOR (EDF, before
         any worker saw it) by a new arrival with an earlier deadline."""
-        with self._lock:
-            self._door_shed += n
+        self._c["door_shed"].inc(n)
 
     def observe_rerouted(self, n=1):
         """A request sent to a different worker than first choice —
         either its preferred worker was unhealthy/at-capacity at pick
         time, or its dispatch failed and the one cross-worker retry ran."""
-        with self._lock:
-            self._rerouted += n
+        self._c["rerouted"].inc(n)
 
     def observe_respawn(self, n=1):
         """A worker PROCESS was restarted (crash, breaker trip, or
         heartbeat loss) and came back ready."""
-        with self._lock:
-            self._respawns += n
+        self._c["respawns"].inc(n)
 
     def observe_heartbeat_miss(self, n=1):
-        with self._lock:
-            self._heartbeat_misses += n
+        self._c["heartbeat_misses"].inc(n)
 
     def observe_deadline_refused(self, n=1):
         """A worker refused a request whose propagated budget was already
         spent — deadline propagation doing its job (the alternative is
         executing work nobody is waiting for)."""
-        with self._lock:
-            self._deadline_refused += n
+        self._c["deadline_refused"].inc(n)
 
     def observe_decode_step(self, live, bucket, generated):
         """One pass of the continuous-batching decode loop: ``live``
         occupied slots out of ``bucket`` (the padded slot-table size),
         ``generated`` tokens actually sampled this step (forced prompt
         ingestion doesn't count)."""
-        with self._lock:
-            self._decode_steps += 1
-            self._decode_tokens += generated
-            self._slot_live += live
-            self._slot_total += bucket
+        self._c["decode_steps"].inc()
+        self._c["decode_tokens"].inc(generated)
+        self._c["slot_live"].inc(live)
+        self._c["slot_total"].inc(bucket)
 
     def observe_ttft(self, latency_s):
         """Admission -> first sampled token for one request."""
@@ -151,57 +169,65 @@ class ServingMetrics:
         self.tpot.add(latency_s)
 
     def observe_batch(self, actual, bucket, cache_hit):
-        with self._lock:
-            self._batches += 1
-            self._batched_examples += actual
-            self._bucket_slots += bucket
-            if cache_hit:
-                self._cache_hits += 1
-            else:
-                self._cache_misses += 1
+        self._c["batches"].inc()
+        self._c["batched_examples"].inc(actual)
+        self._c["bucket_slots"].inc(bucket)
+        if cache_hit:
+            self._c["compile_cache_hits"].inc()
+        else:
+            self._c["compile_cache_misses"].inc()
 
     # -- export -------------------------------------------------------------
     def snapshot(self):
-        with self._lock:
-            batches = self._batches
-            occupancy = (self._batched_examples / self._bucket_slots
-                         if self._bucket_slots else None)
-            lookups = self._cache_hits + self._cache_misses
-            snap = {
-                "requests_completed": self._completed,
-                "requests_failed": self._failed,
-                "requests_rejected": self._rejected,
-                "requests_expired": self._expired,
-                "requests_shed": self._shed,
-                "requests_retried": self._retried,
-                "replicas_evicted": self._evicted,
-                "workers_respawned": self._respawned,
-                "door_shed": self._door_shed,
-                "rerouted": self._rerouted,
-                "respawns": self._respawns,
-                "heartbeat_misses": self._heartbeat_misses,
-                "deadline_refused": self._deadline_refused,
-                "queue_depth": self._queue_depth_fn(),
-                "in_flight": self._in_flight_fn(),
-                "batches": batches,
-                "batch_occupancy": occupancy,
-                "avg_batch_size": (self._batched_examples / batches
-                                   if batches else None),
-                "compile_cache_hits": self._cache_hits,
-                "compile_cache_misses": self._cache_misses,
-                "compile_cache_hit_rate": (self._cache_hits / lookups
-                                           if lookups else None),
-                "decode_steps": self._decode_steps,
-                "decode_tokens": self._decode_tokens,
-                "slot_occupancy": (self._slot_live / self._slot_total
-                                   if self._slot_total else None),
-            }
+        c = {field: counter.value for field, counter in self._c.items()}
+        batches = c["batches"]
+        lookups = c["compile_cache_hits"] + c["compile_cache_misses"]
+        snap = {
+            "requests_completed": c["requests_completed"],
+            "requests_failed": c["requests_failed"],
+            "requests_rejected": c["requests_rejected"],
+            "requests_expired": c["requests_expired"],
+            "requests_shed": c["requests_shed"],
+            "requests_retried": c["requests_retried"],
+            "replicas_evicted": c["replicas_evicted"],
+            "workers_respawned": c["workers_respawned"],
+            "door_shed": c["door_shed"],
+            "rerouted": c["rerouted"],
+            "respawns": c["respawns"],
+            "heartbeat_misses": c["heartbeat_misses"],
+            "deadline_refused": c["deadline_refused"],
+            "queue_depth": self._queue_depth_fn(),
+            "in_flight": self._in_flight_fn(),
+            "batches": batches,
+            "batch_occupancy": (c["batched_examples"] / c["bucket_slots"]
+                                if c["bucket_slots"] else None),
+            "avg_batch_size": (c["batched_examples"] / batches
+                               if batches else None),
+            "compile_cache_hits": c["compile_cache_hits"],
+            "compile_cache_misses": c["compile_cache_misses"],
+            "compile_cache_hit_rate": (c["compile_cache_hits"] / lookups
+                                       if lookups else None),
+            "decode_steps": c["decode_steps"],
+            "decode_tokens": c["decode_tokens"],
+            "slot_occupancy": (c["slot_live"] / c["slot_total"]
+                               if c["slot_total"] else None),
+        }
         lat = self.latency.percentiles((50, 95, 99))
         snap["latency_s"] = {k: lat[k] for k in ("p50", "p95", "p99")}
         for name, hist in (("ttft_s", self.ttft), ("tpot_s", self.tpot)):
             ps = hist.percentiles((50, 95, 99))
             snap[name] = {k: ps[k] for k in ("p50", "p95", "p99")}
         return snap
+
+    def prometheus_text(self):
+        """Prometheus exposition: this instance's registry plus the
+        process-wide MFU/roofline gauge (populated when ``Executor.run``
+        executes under tracing)."""
+        text = self.registry.prometheus_text()
+        mfu = _MFU.prometheus_lines()
+        if mfu:
+            text += "\n".join(mfu) + "\n"
+        return text
 
     def report(self):
         """Formatted table in the ``profiler._report`` house style."""

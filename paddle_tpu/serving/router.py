@@ -73,6 +73,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..distributed.launch import reap_procs
+from ..obs import flight, trace
 from ..reliability import faults
 from ..reliability.policy import CircuitBreaker, Deadline, RetryError, \
     RetryPolicy
@@ -319,12 +320,15 @@ class Router:
                     w.respawning = False
                     self._cv.notify_all()
                 self.metrics_.observe_respawn()
+                flight.record("worker.respawn", worker=w.index, why=why)
             except (RetryError, RouterShutdownError) as e:
                 # budget spent: the worker stays down, the rest of the
                 # fleet keeps serving; operators see it in metrics()
                 with self._cv:
                     w.respawning = False
                 w.tail.append("respawn gave up (%s): %r" % (why, e))
+                flight.record("worker.respawn_gave_up", worker=w.index,
+                              why=why, error=repr(e)[:200])
 
         t = threading.Thread(target=_run, daemon=True,
                              name="router-respawn-%d" % w.index)
@@ -413,6 +417,7 @@ class Router:
                 self._entries.discard(victim)
                 self._entries.add(entry)
                 self.metrics_.observe_door_shed()
+                flight.record("edf.shed", where="router.door")
                 self._cv.notify_all()
                 return entry
             self.metrics_.observe_rejected()
@@ -517,6 +522,11 @@ class Router:
         fwd = dict(header)
         if deadline is not None:
             fwd["deadline_s"] = deadline.remaining()
+        # re-parent the propagated trace onto OUR current span (the
+        # dispatch span) so the worker's spans nest under this hop; with
+        # router tracing off the client's context forwards verbatim,
+        # since fwd already carries the original "trace" key
+        trace.inject(fwd)
         with w.sockets_lock:
             sock = w.sockets.popleft() if w.sockets else None
         generation = w.generation
@@ -550,7 +560,10 @@ class Router:
         """Admitted request -> reply, with the one cross-worker retry.
         Always returns a reply pair; typed errors, never silence."""
         key = header.get("key")
-        w = self._acquire(entry, key)
+        with trace.span("router.queue") as sp:
+            w = self._acquire(entry, key)
+            if sp:
+                sp.set(worker=w.index)
         try:
             try:
                 reply = self._send_to_worker(w, header, arrays, deadline)
@@ -567,8 +580,11 @@ class Router:
         self.metrics_.observe_rerouted()
         with self._cv:
             self._entries.add(entry)
-        w2 = self._acquire(entry, key, exclude=w if self.num_workers > 1
-                           else None)
+        with trace.span("router.queue") as sp:
+            w2 = self._acquire(entry, key, exclude=w
+                               if self.num_workers > 1 else None)
+            if sp:
+                sp.set(worker=w2.index, retry=1)
         try:
             reply = self._send_to_worker(w2, header, arrays, deadline)
             with self._cv:
@@ -587,34 +603,56 @@ class Router:
         budget = header.get("deadline_s")
         deadline = None if budget is None \
             else Deadline(budget, clock=self.clock)
+        # adopt the client's propagated trace context as this handler
+        # thread's ambient parent, so the door/dispatch spans (and the
+        # context _send_to_worker re-injects) stitch onto ONE trace
+        tracer = trace.active()
+        token = None
+        if tracer is not None:
+            ctx = trace.extract(header)
+            if ctx is not None:
+                token = tracer.activate(ctx)
         try:
-            entry = self._admit(deadline)
-            reply_header, reply_arrays = self._dispatch(
-                entry, header, arrays, deadline)
-        except ServerOverloadedError as e:
-            return {"type": "error", "error": "ServerOverloaded",
-                    "message": str(e)}, None
-        except RouterShutdownError as e:
-            return {"type": "error", "error": "RouterShutdown",
-                    "message": str(e)}, None
-        except WorkerFailedError as e:
-            self.metrics_.observe_failed()
-            return {"type": "error", "error": "WorkerFailed",
-                    "message": str(e)}, None
-        if reply_header.get("type") == "error":
-            kind = reply_header.get("error")
-            if kind == "DeadlineRefused":
-                self.metrics_.observe_deadline_refused()
-                self.metrics_.observe_expired()
-            elif kind == "DeadlineExceeded":
-                # budget survived to the worker but died in its engine
-                # queue: a deadline outcome, not a worker failure
-                self.metrics_.observe_expired()
-            else:
+            try:
+                with trace.span("router.door") as sp:
+                    entry = self._admit(deadline)
+                    if sp:
+                        sp.set(budget_s=budget)
+                with trace.span("router.dispatch"):
+                    reply_header, reply_arrays = self._dispatch(
+                        entry, header, arrays, deadline)
+            except ServerOverloadedError as e:
+                flight.record("request.outcome", outcome="ServerOverloaded")
+                return {"type": "error", "error": "ServerOverloaded",
+                        "message": str(e)}, None
+            except RouterShutdownError as e:
+                flight.record("request.outcome", outcome="RouterShutdown")
+                return {"type": "error", "error": "RouterShutdown",
+                        "message": str(e)}, None
+            except WorkerFailedError as e:
                 self.metrics_.observe_failed()
-        else:
-            self.metrics_.observe_completed(self.clock() - t0)
-        return reply_header, reply_arrays
+                flight.record("request.outcome", outcome="WorkerFailed")
+                return {"type": "error", "error": "WorkerFailed",
+                        "message": str(e)}, None
+            if reply_header.get("type") == "error":
+                kind = reply_header.get("error")
+                if kind == "DeadlineRefused":
+                    self.metrics_.observe_deadline_refused()
+                    self.metrics_.observe_expired()
+                elif kind == "DeadlineExceeded":
+                    # budget survived to the worker but died in its engine
+                    # queue: a deadline outcome, not a worker failure
+                    self.metrics_.observe_expired()
+                else:
+                    self.metrics_.observe_failed()
+                flight.record("request.outcome", outcome=kind)
+            else:
+                self.metrics_.observe_completed(self.clock() - t0)
+                flight.record("request.outcome", outcome="completed")
+            return reply_header, reply_arrays
+        finally:
+            if token is not None:
+                tracer.deactivate(token)
 
     def _worker_states(self):
         with self._cv:
@@ -650,12 +688,18 @@ class Router:
                     if kind == "infer":
                         resp, out = router._handle_infer(header, arrays)
                     elif kind == "ping":
-                        resp, out = {"type": "pong"}, None
+                        # the ping path doubles as the scrape endpoint:
+                        # Prometheus exposition text rides in the pong
+                        resp, out = {
+                            "type": "pong",
+                            "prometheus": router.metrics_.prometheus_text(),
+                        }, None
                     elif kind == "metrics":
                         resp, out = {
                             "type": "metrics",
                             "snapshot": router.metrics_.snapshot(),
                             "workers": router._worker_states(),
+                            "prometheus": router.metrics_.prometheus_text(),
                         }, None
                     else:
                         resp, out = {"type": "error", "error": "Rpc",
@@ -729,6 +773,8 @@ class Router:
         for w in self._workers:
             w.close_sockets()
         reap_procs([w.proc for w in self._workers], grace_s=grace_s)
+        trace.flush()
+        flight.maybe_dump(reason="router-shutdown")
 
     def __enter__(self):
         if self._server is None:
@@ -798,8 +844,14 @@ class RouterClient:
             header["key"] = key
         if deadline is not None:
             header["deadline_s"] = deadline.remaining()
-        reply_header, arrays = self._roundtrip(
-            header, {k: np.asarray(v) for k, v in feed.items()})
+        with trace.span("client.predict") as sp:
+            # the root of the cross-process trace: inject THIS span's
+            # context so every hop downstream stitches onto one trace id
+            trace.inject(header)
+            reply_header, arrays = self._roundtrip(
+                header, {k: np.asarray(v) for k, v in feed.items()})
+            if sp and reply_header.get("type") == "error":
+                sp.set(error=reply_header.get("error"))
         if reply_header.get("type") == "error":
             self._raise_typed(reply_header)
         n = reply_header.get("n_out", 0)
@@ -830,6 +882,13 @@ class RouterClient:
             self._raise_typed(header)
         return {"snapshot": header["snapshot"],
                 "workers": header["workers"]}
+
+    def prometheus(self):
+        """Scrape the router's Prometheus exposition text (ping path)."""
+        header, _ = self._roundtrip({"type": "ping"}, None)
+        if header.get("type") == "error":
+            self._raise_typed(header)
+        return header.get("prometheus", "")
 
     def close(self):
         self._closed = True
@@ -866,6 +925,8 @@ def main(argv=None):
                          "(repeatable)")
     args = ap.parse_args(argv)
 
+    trace.maybe_start_from_env()
+    flight.install()
     router = Router(args.model, num_workers=args.workers, host=args.host,
                     port=args.port, routing=args.routing,
                     max_queue_depth=args.max_queue_depth,

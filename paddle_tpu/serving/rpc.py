@@ -25,6 +25,15 @@ absolute timestamp: the two processes do not share a clock). Each hop
 re-derives a local :class:`~paddle_tpu.reliability.policy.Deadline` from
 it, so queue time spent anywhere on the path keeps counting and a worker
 can refuse already-expired work without doing it.
+
+Trace propagation convention (``paddle_tpu.obs.trace``): request headers
+may carry ``trace`` — ``{"tid": <64-bit-hex trace id>, "sid": <64-bit-hex
+span id>}``. Unlike the deadline, the TRACE ID propagates verbatim for
+the whole request (it names the trace); the SPAN ID is re-injected by
+each hop with its own current span, so the receiver's spans parent under
+the sender's. Peers that don't trace ignore the key (unknown header keys
+are always ignored — that is what makes both conventions zero wire-format
+changes) and, when forwarding, keep it intact by copying the header.
 """
 
 import io

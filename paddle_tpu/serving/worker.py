@@ -46,6 +46,7 @@ import threading
 
 import numpy as np
 
+from ..obs import flight, trace
 from ..reliability import faults
 from . import rpc
 from .admission import DeadlineExceededError, ServerOverloadedError
@@ -122,13 +123,27 @@ def _handle_infer(state, header, arrays):
         # in the router queue is refused BEFORE an engine slot is wasted
         with state.lock:
             state.deadline_refused += 1
+        flight.record("deadline.refused", where="worker",
+                      overdue_s=-remaining)
         return {"type": "error", "error": "DeadlineRefused",
                 "message": "request budget expired %.3fs before it "
                            "reached the worker" % -remaining}, None
+    # adopt the router-propagated context so the queue span (and the
+    # engine.batch span parented through req.trace_ctx) stitch onto the
+    # client's trace id
+    tracer = trace.active()
+    token = None
+    if tracer is not None:
+        ctx = trace.extract(header)
+        if ctx is not None:
+            token = tracer.activate(ctx)
     try:
-        fut = state.engine.submit(dict(arrays), timeout_s=remaining)
-        outs = fut.result(remaining + 30.0 if remaining is not None
-                          else 300.0)
+        with trace.span("worker.queue") as sp:
+            fut = state.engine.submit(dict(arrays), timeout_s=remaining)
+            outs = fut.result(remaining + 30.0 if remaining is not None
+                              else 300.0)
+            if sp:
+                sp.set(pid=os.getpid())
     except ServerOverloadedError as e:
         return {"type": "error", "error": "ServerOverloaded",
                 "message": str(e)}, None
@@ -138,6 +153,9 @@ def _handle_infer(state, header, arrays):
     except Exception as e:
         return {"type": "error", "error": "WorkerFailed",
                 "message": "%s: %s" % (type(e).__name__, e)}, None
+    finally:
+        if token is not None:
+            tracer.deactivate(token)
     with state.lock:
         state.served += 1
     out_arrays = {"o%d" % i: np.asarray(o) for i, o in enumerate(outs)}
@@ -169,6 +187,15 @@ def _make_server(host, port, state):
                                  "stats": _stats(state)}, None
                 elif kind == "infer":
                     resp, out = _handle_infer(state, header, arrays)
+                elif kind == "stats":
+                    # the scrape verb: gauges plus the engine's full
+                    # Prometheus exposition (including the MFU gauge)
+                    resp, out = {
+                        "type": "stats",
+                        "stats": _stats(state),
+                        "prometheus":
+                            state.engine.metrics_.prometheus_text(),
+                    }, None
                 elif kind == "shutdown":
                     resp, out = {"type": "ok"}, None
                 else:
@@ -215,6 +242,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     faults.maybe_install_from_env()
+    trace.maybe_start_from_env()
+    flight.install()
     from .engine import ServingEngine
 
     ladder = tuple(int(x) for x in args.ladder.split(",") if x.strip())
@@ -251,6 +280,10 @@ def main(argv=None):
     finally:
         server.server_close()
         engine.shutdown(drain=True, timeout_s=5.0)
+        # SIGTERM from the router lands here via _on_term -> shutdown,
+        # so a reaped worker still flushes its trace shard and flight ring
+        trace.flush()
+        flight.maybe_dump(reason="worker-shutdown")
     return 0
 
 

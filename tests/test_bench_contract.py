@@ -97,6 +97,10 @@ def test_serving_bench_record(monkeypatch):
     assert set(rel) == {"requests_shed", "requests_retried",
                         "replicas_evicted", "workers_respawned"}
     assert all(v == 0 for v in rel.values()), rel
+    # ISSUE 17: every record carries its telemetry view; untraced runs
+    # say so explicitly (no trace path, no spans, no MFU reading)
+    assert rec["obs"] == {"traced": False, "trace_path": None,
+                          "span_count": 0, "mfu_vs_model": None}
 
 
 def test_seq_override_metric_suffix(monkeypatch):
@@ -173,6 +177,41 @@ def test_resnet50_record_carries_rederived_ceiling(monkeypatch):
                         lambda: {"hbm_operative_gbs": 777.0})
     rec2 = bench._bench_static("resnet50", on_tpu=False)
     assert rec2["config"]["hbm_gbs"] == 777.0
+
+
+def test_bench_trace_obs_field(monkeypatch, tmp_path):
+    """ISSUE 17: under BENCH_TRACE=1 the record's ``obs`` field points at
+    a real trace capture — executor.run spans for the measured steps —
+    and carries the MFU gauge's model-agreement figure for exactly this
+    config's window."""
+    import json
+
+    import bench
+    from paddle_tpu.obs import trace
+
+    monkeypatch.setattr(bench, "_build", _tiny_build)
+    monkeypatch.setenv("BENCH_STEPS", "1")
+    monkeypatch.setenv("BENCH_TRACE", "1")
+    monkeypatch.setenv("BENCH_TRACE_DIR", str(tmp_path))
+    try:
+        rec = bench._bench_static("resnet50", on_tpu=False)
+    finally:
+        trace.stop()
+    obs = rec["obs"]
+    assert obs["traced"] is True
+    assert obs["span_count"] > 0
+    assert obs["mfu_vs_model"] is not None and obs["mfu_vs_model"] > 0
+    assert obs["trace_path"].startswith(str(tmp_path))
+    with open(obs["trace_path"], encoding="utf-8") as f:
+        spans = [json.loads(line) for line in f if line.strip()]
+    # warmup(2) + BENCH_STEPS(1) executor.run spans, plus startup
+    assert sum(1 for s in spans if s["name"] == "executor.run") >= 3
+    # untraced runs reset the gauge: a second record doesn't inherit the
+    # first's MFU reading
+    monkeypatch.setenv("BENCH_TRACE", "0")
+    rec2 = bench._bench_static("resnet50", on_tpu=False)
+    assert rec2["obs"] == {"traced": False, "trace_path": None,
+                           "span_count": 0, "mfu_vs_model": None}
 
 
 def test_seq2048_record_carries_stream_config(monkeypatch):

@@ -1,0 +1,522 @@
+"""Telemetry plane (ISSUE 17): tracing, metrics registry, flight
+recorder, and the live MFU gauge.
+
+The expensive acceptance drills live here too: one ``RouterClient.
+predict`` against a REAL router subprocess must produce ONE stitched
+trace across client, router, and worker processes; and a SIGKILL chaos
+burst must leave a flight-recorder dump that accounts for every
+accepted request.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from paddle_tpu.obs import flight, trace
+from paddle_tpu.obs.registry import MFU, Registry
+from paddle_tpu.serving import (DeadlineExceededError, Router, RouterClient,
+                                ServerOverloadedError, WorkerFailedError)
+from paddle_tpu.serving import rpc
+from paddle_tpu.serving.metrics import ServingMetrics
+from paddle_tpu.serving.router import ROUTER_READY_PREFIX
+
+FC_FEED = {"x": np.full((1, 8), 0.5, "float32")}
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Every test starts and ends with tracing disabled — the module
+    global must never leak between tests (or into other test files)."""
+    trace.stop()
+    yield
+    trace.stop()
+
+
+def _wait_for(cond, timeout=60.0, what="condition"):
+    t0 = time.time()
+    while not cond():
+        assert time.time() - t0 < timeout, "timed out waiting for " + what
+        time.sleep(0.05)
+
+
+# -- spans under a fake clock ----------------------------------------------
+
+def test_fake_clock_span_nesting_and_determinism():
+    clk = {"t": 100.0}
+    tracer = trace.Tracer(clock=lambda: clk["t"])
+    with tracer.span("outer") as outer:
+        clk["t"] += 1.0
+        with tracer.span("inner") as inner:
+            clk["t"] += 0.5
+        clk["t"] += 0.25
+    spans = {s["name"]: s for s in tracer.drain()}
+    assert spans["inner"]["parent_id"] == outer.span_id
+    assert spans["outer"]["parent_id"] is None
+    assert spans["inner"]["trace_id"] == outer.trace_id
+    assert inner.trace_id == outer.trace_id
+    # injected clock => wall offset is forced to zero, times are EXACT
+    assert spans["outer"]["t0"] == 100.0
+    assert spans["outer"]["dur"] == 1.75
+    assert spans["inner"]["t0"] == 101.0
+    assert spans["inner"]["dur"] == 0.5
+    # context popped cleanly: a new span is a fresh root
+    with tracer.span("later"):
+        pass
+    later = tracer.drain()[0]
+    assert later["parent_id"] is None
+    assert later["trace_id"] != outer.trace_id
+
+
+def test_span_records_error_tag_and_sets_tags():
+    tracer = trace.Tracer(clock=lambda: 0.0)
+    with pytest.raises(ValueError):
+        with tracer.span("boom") as sp:
+            sp.set(n=4)
+            raise ValueError("x")
+    rec = tracer.drain()[0]
+    assert rec["tags"] == {"n": 4, "error": "ValueError"}
+
+
+def test_explicit_parent_crosses_threads():
+    tracer = trace.Tracer(clock=lambda: 0.0)
+    with tracer.span("submit") as sub:
+        ctx = tracer.current()
+    done = threading.Event()
+
+    def worker():
+        with tracer.span("batch", parent=ctx):
+            pass
+        done.set()
+
+    threading.Thread(target=worker).start()
+    assert done.wait(10.0)
+    spans = {s["name"]: s for s in tracer.drain()}
+    assert spans["batch"]["trace_id"] == sub.trace_id
+    assert spans["batch"]["parent_id"] == sub.span_id
+
+
+# -- propagation over the rpc header ---------------------------------------
+
+def test_inject_extract_roundtrip_through_rpc_frame():
+    header = {"type": "infer", "deadline_s": 1.5}
+    ctx = ("00ab" * 4, "11cd" * 4)
+    trace.inject(header, ctx=ctx)
+    # the trace key must survive real wire framing beside deadline_s
+    payload = rpc.encode_msg(header, {"x": np.ones(3, "f4")})
+    got_header, _ = rpc.decode_msg(payload)
+    assert got_header["deadline_s"] == 1.5
+    assert trace.extract(got_header) == ctx
+    # extract works with NO tracer installed, and tolerates absence/junk
+    assert trace.extract({"type": "infer"}) is None
+    assert trace.extract({"trace": "garbage"}) is None
+    # inject with no tracer and no explicit ctx is a no-op
+    h = {"type": "infer"}
+    assert trace.inject(h) == {"type": "infer"}
+
+
+def test_each_hop_reparents_but_trace_id_propagates_verbatim():
+    tracer = trace.Tracer(clock=lambda: 0.0)
+    with tracer.span("client") as c:
+        header = {}
+        trace.inject(header, ctx=c.context())
+    # router adopts, opens its own span, re-injects
+    ctx = trace.extract(header)
+    token = tracer.activate(ctx)
+    try:
+        with tracer.span("router") as r:
+            fwd = dict(header)
+            trace.inject(fwd, ctx=tracer.current())
+    finally:
+        tracer.deactivate(token)
+    tid, sid = trace.extract(fwd)
+    assert tid == c.trace_id  # verbatim across both hops
+    assert sid == r.span_id  # re-parented onto the router's span
+    assert r.parent_id == c.span_id
+
+
+# -- disabled hot path: the zero-allocation contract ------------------------
+
+def test_disabled_span_is_falsy_singleton():
+    assert trace.active() is None
+    sp = trace.span("x")
+    assert sp is trace.span("y")
+    assert not sp
+    assert sp.set(a=1) is sp
+    assert sp.context() is None
+    with sp:
+        pass
+    assert trace.current() is None
+    assert trace.flush() is None
+
+
+def test_disabled_hot_path_zero_allocations():
+    def hot():
+        for _ in range(200):
+            sp = trace.span("x")
+            if sp:
+                sp.set(a=1)  # guarded call sites never allocate the dict
+            trace.current()
+            trace.flush()
+
+    hot()  # warm any lazy caches before measuring
+    tracemalloc.start()
+    try:
+        before = tracemalloc.take_snapshot()
+        hot()
+        after = tracemalloc.take_snapshot()
+    finally:
+        tracemalloc.stop()
+    leaks = [s for s in after.compare_to(before, "lineno")
+             if s.traceback[0].filename == trace.__file__
+             and s.size_diff > 0]
+    assert not leaks, "disabled tracing allocated: %s" % leaks
+
+
+# -- tracing overhead <5% on the serving smoke path -------------------------
+
+def test_tracing_overhead_under_5_percent_of_a_serving_request():
+    """Per-span cost (enabled minus disabled) times the spans a routed
+    request emits must stay under 5% of a real fc-engine request."""
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.worker import build_model
+
+    n = 3000
+
+    def span_loop():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with trace.span("bench") as sp:
+                if sp:
+                    sp.set(k=1)
+        return (time.perf_counter() - t0) / n
+
+    disabled = min(span_loop() for _ in range(3))
+    tracer = trace.start(max_spans=8 * n)
+    try:
+        enabled = min(span_loop() for _ in range(3))
+        assert len(tracer.spans) >= n
+    finally:
+        trace.stop()
+    per_span = max(0.0, enabled - disabled)
+
+    engine = ServingEngine(build_model("builtin:fc"), num_replicas=1,
+                           ladder=(1, 2, 4, 8))
+    try:
+        engine.warmup()
+        lat = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            engine.predict(FC_FEED, timeout_s=30.0)
+            lat.append(time.perf_counter() - t0)
+        request_s = sorted(lat)[len(lat) // 2]
+    finally:
+        engine.shutdown()
+    # a routed predict opens ~7 spans end to end (client, door, queue,
+    # dispatch, worker queue, engine batch, executor run); budget 8
+    overhead = 8 * per_span
+    assert overhead < 0.05 * request_s, (
+        "tracing overhead %.1fus vs request %.1fus (%.2f%%)"
+        % (overhead * 1e6, request_s * 1e6,
+           100.0 * overhead / request_s))
+
+
+# -- metrics registry + Prometheus exposition -------------------------------
+
+_PROM_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_PROM_VALUE = r"(?:[+-]?[0-9.eE+-]+|NaN|\+Inf|-Inf)"
+_PROM_LINE = re.compile(
+    r"^(?:# HELP %(n)s .*"
+    r"|# TYPE %(n)s (?:counter|gauge|summary|histogram)"
+    r"|%(n)s(?:\{[^}]*\})? %(v)s)$"
+    % {"n": _PROM_NAME, "v": _PROM_VALUE})
+
+
+def test_prometheus_exposition_grammar():
+    m = ServingMetrics()
+    m.observe_completed(0.010)
+    m.observe_completed(0.020)
+    m.observe_batch(actual=4, bucket=8, cache_hit=False)
+    m.observe_decode_step(live=3, bucket=4, generated=3)
+    m.bind_gauges(lambda: 2, lambda: 5)
+    MFU.reset()
+    MFU.record(0.004, {"roofline_s": 0.002, "flops": 1e9, "bound": "hbm",
+                       "ceilings": {"matmul_flops": 1e12}})
+    try:
+        text = m.prometheus_text()
+    finally:
+        MFU.reset()
+    assert text.endswith("\n")
+    for line in text.rstrip("\n").split("\n"):
+        assert _PROM_LINE.match(line), "bad exposition line: %r" % line
+    assert "paddle_tpu_serving_requests_completed 2" in text
+    assert "paddle_tpu_serving_queue_depth 2" in text
+    assert "paddle_tpu_serving_in_flight 5" in text
+    assert 'paddle_tpu_serving_latency_seconds{quantile="0.5"}' in text
+    assert "paddle_tpu_serving_latency_seconds_count 2" in text
+    assert "paddle_tpu_mfu_vs_model 0.5" in text
+    assert "paddle_tpu_mfu " in text
+    # a TYPE line precedes every sample family
+    assert text.index("# TYPE paddle_tpu_serving_requests_completed "
+                      "counter") < text.index(
+        "paddle_tpu_serving_requests_completed 2")
+
+
+def test_registry_rejects_bad_names_and_kind_conflicts():
+    r = Registry()
+    with pytest.raises(ValueError):
+        r.counter("0bad")
+    with pytest.raises(ValueError):
+        r.counter("has space")
+    r.counter("ok_total")
+    with pytest.raises(TypeError):
+        r.gauge("ok_total")
+    assert r.counter("ok_total") is r.get("ok_total")  # idempotent
+
+
+def test_registry_snapshot_consistency():
+    """Satellite 2: the registry IS the storage — every pinned snapshot
+    counter field must equal its registry metric, always."""
+    m = ServingMetrics()
+    m.observe_completed(0.01)
+    m.observe_failed(2)
+    m.observe_rejected()
+    m.observe_expired(3)
+    m.observe_shed()
+    m.observe_retried()
+    m.observe_evicted()
+    m.observe_respawned()
+    m.observe_door_shed()
+    m.observe_rerouted(2)
+    m.observe_respawn()
+    m.observe_heartbeat_miss(4)
+    m.observe_deadline_refused()
+    m.observe_batch(actual=3, bucket=4, cache_hit=True)
+    m.observe_decode_step(live=2, bucket=4, generated=1)
+    m.bind_gauges(lambda: 7, lambda: 1)
+    snap = m.snapshot()
+    vals = m.registry.values()
+    for field in ("requests_completed", "requests_failed",
+                  "requests_rejected", "requests_expired", "requests_shed",
+                  "requests_retried", "replicas_evicted",
+                  "workers_respawned", "door_shed", "rerouted", "respawns",
+                  "heartbeat_misses", "deadline_refused", "batches",
+                  "compile_cache_hits", "compile_cache_misses",
+                  "decode_steps", "decode_tokens", "queue_depth",
+                  "in_flight"):
+        assert vals["paddle_tpu_serving_" + field] == snap[field], field
+    # derived fields still derive from registry counters
+    assert snap["batch_occupancy"] == 3 / 4
+    assert snap["slot_occupancy"] == 2 / 4
+    assert snap["compile_cache_hit_rate"] == 1.0
+    # the pinned snapshot field list itself is unchanged (the contract
+    # test_bench_contract.py leans on)
+    assert set(snap) == {
+        "requests_completed", "requests_failed", "requests_rejected",
+        "requests_expired", "requests_shed", "requests_retried",
+        "replicas_evicted", "workers_respawned", "door_shed", "rerouted",
+        "respawns", "heartbeat_misses", "deadline_refused", "queue_depth",
+        "in_flight", "batches", "batch_occupancy", "avg_batch_size",
+        "compile_cache_hits", "compile_cache_misses",
+        "compile_cache_hit_rate", "decode_steps", "decode_tokens",
+        "slot_occupancy", "latency_s", "ttft_s", "tpot_s"}
+
+
+# -- MFU gauge vs the static cost model -------------------------------------
+
+def test_mfu_gauge_agrees_with_static_model_on_fc_program():
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis.cost import estimate_program
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    main_prog.random_seed = startup.random_seed = 11
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        x = fluid.layers.data("x", shape=[8])
+        prob = fluid.layers.softmax(fluid.layers.fc(x, size=4))
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        feed = {"x": np.full((8, 8), 0.5, "float32")}
+        MFU.reset()
+        trace.start()
+        try:
+            for _ in range(3):
+                exe.run(main_prog, feed=feed, fetch_list=[prob])
+        finally:
+            trace.stop()
+    snap = MFU.snapshot()
+    MFU.reset()
+    assert snap["steps"] == 3
+    expected = estimate_program(
+        main_prog, batch=8, feed_names=["x"]).roofline()
+    # the recorded roofline is EXACTLY the static model's (same code
+    # path), so model-vs-measured agreement is what the gauge adds
+    assert snap["roofline_s"] / 3 == pytest.approx(
+        expected["roofline_s"], rel=1e-9)
+    assert snap["measured_s"] > 0
+    assert snap["mfu_vs_model"] > 0
+    assert 0 < snap["mfu"] < 1  # tiny fc on CPU is nowhere near peak
+
+
+def test_executor_records_no_mfu_when_tracing_disabled():
+    import paddle_tpu as fluid
+
+    main_prog, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        x = fluid.layers.data("x", shape=[4])
+        y = fluid.layers.fc(x, size=2)
+        exe = fluid.Executor(fluid.XLAPlace(0))
+        exe.run(startup)
+        MFU.reset()
+        exe.run(main_prog, feed={"x": np.ones((2, 4), "float32")},
+                fetch_list=[y])
+    assert MFU.snapshot() == {"steps": 0}
+
+
+# -- flight recorder --------------------------------------------------------
+
+def test_flight_recorder_ring_bounds_and_dump(tmp_path, monkeypatch):
+    rec = flight.FlightRecorder(capacity=4, clock=lambda: 1.0)
+    for i in range(10):
+        rec.record("edf.shed", n=i)
+    assert len(rec.events()) == 4  # ring is bounded
+    assert rec.counts() == {"edf.shed": 10}  # counts are not
+    assert [e["n"] for e in rec.events()] == [6, 7, 8, 9]
+    path = rec.dump(str(tmp_path / "f.json"), reason="test")
+    dump = flight.load(path)
+    assert dump["reason"] == "test"
+    assert dump["counts"] == {"edf.shed": 10}
+    assert len(dump["events"]) == 4
+    # maybe_dump is a no-op without the env, dumps with it
+    monkeypatch.delenv(flight.ENV_FLIGHT_DIR, raising=False)
+    assert flight.maybe_dump() is None
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(tmp_path))
+    flight.record("test.event")
+    out = flight.maybe_dump(reason="unit")
+    assert out == flight.dump_path()
+    assert any(e["kind"] == "test.event"
+               for e in flight.load(out)["events"])
+
+
+def test_flight_dump_accounts_for_every_request_after_sigkill(
+        tmp_path, monkeypatch):
+    """The acceptance drill: SIGKILL a worker mid-burst; the shutdown
+    dump must hold one request.outcome per accepted request (zero silent
+    telemetry losses) plus the respawn evidence."""
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(tmp_path))
+    router = Router("builtin:fc", num_workers=2, heartbeat_interval_s=0.2)
+    try:
+        router.start()
+        client = RouterClient(router.address, pool_size=8)
+        for _ in range(2):
+            client.predict(FC_FEED, timeout_s=60.0)
+        flight.RECORDER.clear()  # the audited ledger starts here
+        futs = [client.submit(FC_FEED, timeout_s=60.0) for _ in range(8)]
+        os.kill(router._workers[0].pid, signal.SIGKILL)
+        resolved = typed = 0
+        for f in futs:
+            try:
+                f.result(60.0)
+                resolved += 1
+            except (WorkerFailedError, ServerOverloadedError,
+                    DeadlineExceededError):
+                typed += 1
+        assert resolved + typed == 8
+        _wait_for(lambda: router.metrics_.snapshot()["respawns"] >= 1,
+                  what="respawn")
+        client.close()
+    finally:
+        router.shutdown()
+    dump = flight.load(flight.dump_path())
+    assert dump["reason"] == "router-shutdown"
+    outcomes = [e for e in dump["events"]
+                if e["kind"] == "request.outcome"]
+    assert len(outcomes) == 8, dump["counts"]
+    assert sum(1 for e in outcomes if e["outcome"] == "completed") \
+        == resolved
+    assert dump["counts"].get("worker.respawn", 0) >= 1
+
+
+# -- the stitched cross-process trace ---------------------------------------
+
+def _read_ready_line(proc, timeout=120.0):
+    out = {}
+
+    def reader():
+        for line in proc.stdout:
+            if line.startswith(ROUTER_READY_PREFIX):
+                out["info"] = json.loads(line[len(ROUTER_READY_PREFIX):])
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout)
+    return out.get("info")
+
+
+def test_one_predict_one_trace_across_three_processes(tmp_path):
+    """ISSUE 17 acceptance: ONE RouterClient.predict against a 2-worker
+    router subprocess yields ONE trace, stitched by the propagated trace
+    id across client, router, and worker processes."""
+    trace_dir = str(tmp_path / "traces")
+    env = dict(os.environ)
+    env["PADDLE_TPU_TRACE"] = trace_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.serving.router",
+         "--model", "builtin:fc", "--workers", "2"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    try:
+        info = _read_ready_line(proc)
+        assert info, "router never announced READY"
+        trace.start(trace_dir=trace_dir)
+        try:
+            client = RouterClient(("127.0.0.1", info["port"]))
+            (o,) = client.predict(FC_FEED, timeout_s=60.0)
+            assert o.shape == (1, 4)
+            client.close()
+        finally:
+            trace.stop()  # flushes the client's shard
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(60)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(30)
+
+    spans = trace.load_dir(trace_dir)
+    roots = [s for s in spans if s["name"] == "client.predict"]
+    assert len(roots) == 1  # ONE predict -> ONE root
+    tid = roots[0]["trace_id"]
+    tspans = [s for s in spans if s["trace_id"] == tid]
+    names = {s["name"] for s in tspans}
+    # the acceptance set: door, dispatch, worker queue, engine run —
+    # all on the ONE propagated trace id
+    assert {"client.predict", "router.door", "router.dispatch",
+            "worker.queue", "engine.batch", "executor.run"} <= names
+    assert len(tspans) >= 4
+    pids = {s["pid"] for s in tspans}
+    assert len(pids) >= 3, "trace did not span 3 processes: %s" % pids
+    # fully stitched: every non-root parent resolves inside the trace
+    ids = {s["span_id"] for s in tspans}
+    for s in tspans:
+        if s["parent_id"] is not None:
+            assert s["parent_id"] in ids, s
+    # and the stray-span check: nothing from this drill landed on a
+    # DIFFERENT trace id with these names (a broken re-parent would)
+    for s in spans:
+        if s["name"] in ("router.door", "worker.queue", "engine.batch"):
+            assert s["trace_id"] == tid

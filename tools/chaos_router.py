@@ -15,6 +15,13 @@ makes this tool exit nonzero.
 Prints one JSON summary line (counters + verdict) so CI logs stay
 greppable. ``--faults`` forwards a ``PADDLE_TPU_FAULTS`` plan to every
 worker process (e.g. ``predictor.run:error@2``) for wire-level drills.
+
+Since ISSUE 17 the drill also audits the **flight recorder**: it runs
+with ``PADDLE_TPU_FLIGHT`` set, and after shutdown cross-checks the
+dumped ring against the accepted-request ledger — every request accepted
+after warm-up must appear as a ``request.outcome`` event, and a kill
+drill must have left ``worker.respawn`` evidence. A ledger/dump mismatch
+fails the drill exactly like a silent loss would.
 """
 
 import argparse
@@ -22,6 +29,7 @@ import json
 import os
 import signal
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -49,10 +57,16 @@ def main(argv=None):
 
     import numpy as np
 
+    from paddle_tpu.obs import flight
     from paddle_tpu.serving import (DeadlineExceededError, Router,
                                     RouterClient, RouterShutdownError,
                                     ServerOverloadedError,
                                     WorkerFailedError)
+
+    # dump destination for this drill: the in-process router dumps at
+    # shutdown, worker processes inherit the env and dump on reap
+    flight_dir = tempfile.mkdtemp(prefix="paddle-tpu-flight-")
+    os.environ[flight.ENV_FLIGHT_DIR] = flight_dir
 
     worker_env = {}
     if args.faults:
@@ -63,11 +77,15 @@ def main(argv=None):
     summary = {"workers": args.workers, "requests": args.requests,
                "kill": bool(args.kill), "faults": args.faults,
                "accepted": 0, "completed": 0, "typed_errors": {},
-               "silent_losses": 0, "respawns": 0, "recovered": None}
+               "silent_losses": 0, "respawns": 0, "recovered": None,
+               "flight": None}
     try:
         router.start()
         client = RouterClient(router.address, pool_size=8)
         client.predict(feed, timeout_s=args.timeout_s)  # warm the fleet
+        # the audit ledger opens HERE: everything recorded from this
+        # point must be accounted for in the shutdown dump
+        flight.RECORDER.clear()
         futs = [client.submit(feed, timeout_s=args.timeout_s)
                 for _ in range(args.requests)]
         summary["accepted"] = len(futs)
@@ -104,11 +122,46 @@ def main(argv=None):
     finally:
         router.shutdown()
 
+    summary["flight"] = _audit_flight(flight, flight_dir, summary,
+                                      kill=args.kill)
     ok = (summary["silent_losses"] == 0 and summary["completed"] > 0
-          and summary["recovered"] is not False)
+          and summary["recovered"] is not False
+          and summary["flight"]["audit"] == "ok")
     summary["verdict"] = "ok" if ok else "FAIL"
     print(json.dumps(summary))
     return 0 if ok else 1
+
+
+def _audit_flight(flight, flight_dir, summary, kill):
+    """Cross-check the router's shutdown dump against the ledger.
+
+    Post-warm-up, the router answered ``accepted`` burst requests plus
+    (on a kill drill) one recovery probe; each MUST be a
+    ``request.outcome`` event in the dump — a missing outcome is a
+    request the telemetry lost even though the wire answered it."""
+    path = flight.dump_path()
+    try:
+        dump = flight.load(path)
+    except (OSError, ValueError) as e:
+        return {"audit": "FAIL", "error": "no dump at %r: %r" % (path, e)}
+    outcomes = [e for e in dump["events"] if e["kind"] == "request.outcome"]
+    completed = sum(1 for e in outcomes if e.get("outcome") == "completed")
+    probes = 1 if kill else 0  # the recovery probe rides after the burst
+    respawn_evs = sum(1 for e in dump["events"]
+                      if e["kind"] == "worker.respawn")
+    ok = (summary["accepted"] <= len(outcomes)
+          <= summary["accepted"] + probes
+          and completed >= summary["completed"]
+          and (not kill or summary["respawns"] == 0
+               or respawn_evs >= 1))
+    return {
+        "audit": "ok" if ok else "FAIL",
+        "dir": flight_dir,
+        "outcome_events": len(outcomes),
+        "completed_events": completed,
+        "respawn_events": respawn_evs,
+        "counts": dump.get("counts", {}),
+    }
 
 
 if __name__ == "__main__":

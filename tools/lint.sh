@@ -15,6 +15,10 @@
 #      16): one real worker process behind the socket front door, a
 #      small burst, zero silent losses — the multi-process serving path
 #      must stay standing before anything ships.
+#   4. the trace-view smoke (`tools/trace_view.py --smoke`, ISSUE 17):
+#      a deterministic fake-clock capture through the summarizer —
+#      critical path + cross-process stitch check must agree with the
+#      obs/trace span format.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -33,5 +37,7 @@ fi
 JAX_PLATFORMS=cpu "$PY" -m paddle_tpu.analysis --zoo -q
 
 JAX_PLATFORMS=cpu "$PY" tools/chaos_router.py --smoke
+
+JAX_PLATFORMS=cpu "$PY" tools/trace_view.py --smoke
 
 echo "lint.sh: ok"
