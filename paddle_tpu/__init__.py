@@ -44,6 +44,7 @@ from . import checkpoint  # noqa: F401
 from . import inference  # noqa: F401
 from . import serving  # noqa: F401
 from .async_executor import AsyncExecutor  # noqa: F401
+from . import streaming  # noqa: F401
 from . import contrib  # noqa: F401
 from .data.data_feed import DataFeedDesc  # noqa: F401
 from . import dygraph  # noqa: F401

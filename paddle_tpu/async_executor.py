@@ -103,3 +103,35 @@ class AsyncExecutor:
                 "fewer than batch_size=%d records present (partial batches "
                 "are dropped)" % (len(filelist), bs))
         return [np.asarray(v) for v in fetch_vals]
+
+    def run_from_stream(self, program, data_feed, stream, fetch=None,
+                        scope=None, max_bad_records=0, max_steps=None,
+                        on_step=None):
+        """Continuous analog of :meth:`run`: consume a live
+        :class:`~paddle_tpu.streaming.RecordStream` (tail-follow over a
+        growing recordio file set — pure Python, no native toolchain
+        needed) until it closes or ``max_steps`` training steps ran.
+
+        The stream trips the ``recordio.read``/``stream.tail`` fault
+        sites itself; ``max_bad_records`` bounds schema-size-mismatch
+        skips exactly like :meth:`run`. ``on_step(step, fetch_vals)``
+        fires after every executor step (the trainer's publish hook).
+        Returns the number of steps executed."""
+        from .streaming.stream import StreamIngester
+
+        program = program or framework.default_main_program()
+        fetch = fetch or []
+        faults.maybe_install_from_env()
+        scope = scope or global_scope()
+        ingester = StreamIngester(stream, data_feed,
+                                  max_bad_records=max_bad_records)
+        steps = 0
+        for feed in ingester.batches():
+            vals = self._exe.run(program, feed=feed, fetch_list=fetch,
+                                 scope=scope, return_numpy=False)
+            steps += 1
+            if on_step is not None:
+                on_step(steps, vals)
+            if max_steps is not None and steps >= max_steps:
+                break
+        return steps

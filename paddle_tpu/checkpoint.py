@@ -18,6 +18,7 @@ by process 0 only; sharded arrays are written piecewise with their global
 slice indices and reassembled on load.
 """
 
+import contextlib
 import json
 import os
 import shutil
@@ -32,10 +33,13 @@ from .core import framework
 from .core.executor import global_scope
 from .reliability import faults
 
-__all__ = ["save_checkpoint", "load_checkpoint", "CheckpointWriter",
-           "resume_or_init", "AutoCheckpoint"]
+__all__ = ["save_checkpoint", "load_checkpoint", "load_staged",
+           "CheckpointWriter", "resume_or_init", "AutoCheckpoint",
+           "pin_version", "unpin_version", "pinned_versions",
+           "candidate_versions"]
 
 _MANIFEST = "checkpoint_manifest.json"
+_PIN_PREFIX = "PIN."
 
 
 class NoCheckpointError(IOError):
@@ -110,10 +114,20 @@ def _snapshot(value):
 
 def save_checkpoint(executor, checkpoint_dir, trainer_id=None,
                     main_program=None, max_num_checkpoints=3,
-                    scope=None, async_write=True, extra_meta=None):
+                    scope=None, async_write=True, extra_meta=None,
+                    max_versions=None):
     """Write a versioned checkpoint of every persistable (params + optimizer
     accumulators + counters). Returns a :class:`CheckpointWriter`; call
-    ``.wait()`` to block until the files are on disk."""
+    ``.wait()`` to block until the files are on disk.
+
+    ``max_versions`` is the periodic-publish retention knob: when set it
+    overrides ``max_num_checkpoints`` and old versions are garbage
+    collected after each save — EXCEPT versions a serving process has
+    pinned (:func:`pin_version`), which are never removed while their pin
+    file exists. Without it a streaming trainer publishing every N steps
+    grows the checkpoint dir without bound."""
+    if max_versions is not None:
+        max_num_checkpoints = max_versions
     main_program = main_program or framework.default_main_program()
     scope = scope or global_scope()
     proc, nproc = _process_index()
@@ -315,7 +329,9 @@ def _trim(checkpoint_dir, keep, grace_seconds=60.0):
     saves while preserving stale dirs from the abandoned timeline). Never
     remove one touched in the last ``grace_seconds`` — a straggler process
     may still be writing shard files into it (dir mtime updates on every
-    file creation); skipped dirs get trimmed by a later save instead."""
+    file creation); skipped dirs get trimmed by a later save instead.
+    Pinned versions (a serving process holds a ``PIN.<owner>`` file in the
+    dir) do not count against ``keep`` and are never removed."""
     if not keep or keep <= 0:
         return
     import time
@@ -324,6 +340,8 @@ def _trim(checkpoint_dir, keep, grace_seconds=60.0):
     for d in os.listdir(checkpoint_dir):
         if d.startswith("checkpoint_") and d.split("_")[1].isdigit():
             path = os.path.join(checkpoint_dir, d)
+            if _is_pinned(path):
+                continue
             try:
                 dirs.append((os.path.getmtime(path), path))
             except OSError:
@@ -333,7 +351,71 @@ def _trim(checkpoint_dir, keep, grace_seconds=60.0):
     for mtime, path in dirs[:-keep]:
         if grace_seconds > 0 and now - mtime < grace_seconds:
             continue
+        if _is_pinned(path):  # pinned between listdir and rmtree
+            continue
         shutil.rmtree(path, ignore_errors=True)
+
+
+def _is_pinned(vdir):
+    try:
+        return any(f.startswith(_PIN_PREFIX) for f in os.listdir(vdir))
+    except OSError:
+        return False
+
+
+def pin_version(checkpoint_dir, version, owner="serving"):
+    """Drop a ``PIN.<owner>`` marker into ``checkpoint_<version>`` so
+    retention GC (``save_checkpoint(..., max_versions=N)``) never removes
+    the version a serving process is actively serving. Idempotent; raises
+    FileNotFoundError if the version dir does not exist."""
+    vdir = os.path.join(checkpoint_dir, "checkpoint_%d" % int(version))
+    if not os.path.isdir(vdir):
+        raise FileNotFoundError("no such checkpoint version dir: %s" % vdir)
+    with _preserved_mtime(vdir):
+        with open(os.path.join(vdir, _PIN_PREFIX + str(owner)), "w") as f:
+            f.write(str(os.getpid()))
+
+
+def unpin_version(checkpoint_dir, version, owner="serving"):
+    """Remove this owner's pin from ``checkpoint_<version>``; the version
+    becomes eligible for retention GC again once all pins are gone.
+    Missing pin / missing dir is a no-op (the GC may already have run)."""
+    vdir = os.path.join(checkpoint_dir, "checkpoint_%d" % int(version))
+    try:
+        with _preserved_mtime(vdir):
+            os.remove(os.path.join(vdir, _PIN_PREFIX + str(owner)))
+    except OSError:
+        pass
+
+
+@contextlib.contextmanager
+def _preserved_mtime(vdir):
+    """Pin-file churn must not refresh the version dir's mtime — retention
+    GC ranks by write recency, and a just-unpinned stale version would
+    otherwise look freshly written and dodge the very GC unpinning
+    re-enables."""
+    st = os.stat(vdir)
+    try:
+        yield
+    finally:
+        try:
+            os.utime(vdir, (st.st_atime, st.st_mtime))
+        except OSError:
+            pass
+
+
+def pinned_versions(checkpoint_dir):
+    """Version numbers currently holding at least one pin file."""
+    out = set()
+    try:
+        entries = os.listdir(checkpoint_dir)
+    except OSError:
+        return out
+    for d in entries:
+        if d.startswith("checkpoint_") and d.split("_")[1].isdigit():
+            if _is_pinned(os.path.join(checkpoint_dir, d)):
+                out.add(int(d.split("_")[1]))
+    return out
 
 
 def _candidate_versions(checkpoint_dir):
@@ -548,6 +630,48 @@ def load_checkpoint(executor, checkpoint_dir, trainer_id=None,
         for name, value in updates:
             scope.set(name, value)
         return extra
+    raise last_err
+
+
+def candidate_versions(checkpoint_dir):
+    """Complete (manifest-bearing) version numbers under ``checkpoint_dir``,
+    best first: the ``latest`` marker, then the rest by write recency.
+    The model-swap plane polls this to detect fresh publishes."""
+    if not os.path.isdir(checkpoint_dir):
+        return []
+    return _candidate_versions(checkpoint_dir)
+
+
+def load_staged(checkpoint_dir, main_program, version=None):
+    """CRC-verified staged read of one version WITHOUT touching any scope:
+    returns ``(version, updates, extra)`` where ``updates`` is a
+    ``[(name, jax array), ...]`` list ready for an atomic swap (the serving
+    hot-swap plane applies it to a fresh scope and flips a reference).
+
+    With ``version=None`` the newest intact version wins, falling back past
+    corrupt/torn ones exactly like :func:`load_checkpoint`; an explicit
+    ``version`` raises on any damage instead of falling back."""
+    if version is not None:
+        updates, extra = _load_version(
+            os.path.join(checkpoint_dir, "checkpoint_%d" % int(version)),
+            main_program)
+        return int(version), updates, extra
+    versions = candidate_versions(checkpoint_dir)
+    if not versions:
+        raise NoCheckpointError(
+            "no complete checkpoint_<n> directory under %s" % checkpoint_dir)
+    last_err = None
+    for v in versions:
+        try:
+            updates, extra = _load_version(
+                os.path.join(checkpoint_dir, "checkpoint_%d" % v),
+                main_program)
+            return v, updates, extra
+        except (IOError, OSError, KeyError, ValueError, IndexError,
+                zipfile.BadZipFile) as e:
+            warnings.warn("checkpoint_%d is unusable (%s); staging the "
+                          "previous intact version instead" % (v, e))
+            last_err = e
     raise last_err
 
 

@@ -27,7 +27,14 @@ payload the peer rejects), ``rpc.recv`` (before a frame is read;
 path, ``hang(s)`` burns the propagated deadline in the router), and
 ``worker.heartbeat`` (tripped in the router's health loop before each
 ping — ``error`` fakes a missed heartbeat, feeding the per-worker
-breaker and the respawn path).
+breaker and the respawn path). The streaming train-to-serve loop
+(``streaming/``) adds ``stream.tail`` (tripped per tail-follow poll;
+``error`` kills the tailer, ``hang(s)`` stalls it, ``corrupt`` damages
+the first record the poll delivers — a torn tail read) and
+``checkpoint.publish`` (tripped per trainer publish attempt; ``error``
+models a publish dying mid-flight — counted, training continues —
+``corrupt`` lands a damaged version so the swap plane's
+fallback-to-previous-intact path and its circuit breaker engage).
 
 Multi-process note: the env grammar is how faults cross a process
 boundary — the router passes ``worker_env={"PADDLE_TPU_FAULTS":

@@ -663,6 +663,46 @@ class Router:
                 "breaker": w.breaker.state, "stats": dict(w.stats),
             } for w in self._workers]
 
+    def _handle_reload(self, header):
+        """Broadcast the hot-swap verb to every live worker (the
+        streaming publish plane's fleet-wide reload). Each worker stages
+        its own CRC-verified load and flips between micro-batches;
+        in-flight requests finish on the old weights. Per-worker results
+        ride back; the call fails typed only when NO worker swapped."""
+        with self._cv:
+            workers = list(self._workers)
+        fwd = {"type": "reload", "dir": header.get("dir"),
+               "version": header.get("version")}
+        results = []
+        for w in workers:
+            if not w.healthy:
+                results.append({"index": w.index, "error": "unhealthy"})
+                continue
+            try:
+                rh, _ = self._send_to_worker(w, dict(fwd), None, None)
+            except Exception as e:  # noqa: BLE001 — per-worker verdicts
+                results.append({"index": w.index,
+                                "error": "%s: %s" % (type(e).__name__, e)})
+                continue
+            if rh.get("type") == "reloaded":
+                results.append({"index": w.index,
+                                "version": rh.get("version")})
+            else:
+                results.append({"index": w.index,
+                                "error": rh.get("message",
+                                                rh.get("error"))})
+        swapped = [r["version"] for r in results if "version" in r]
+        if not swapped:
+            flight.record("model.swap_failed", where="router",
+                          workers=len(results))
+            return {"type": "error", "error": "ReloadFailed",
+                    "message": "no worker swapped: %s" % (results,)}, None
+        version = min(swapped)
+        flight.record("model.swap", where="router", version=version,
+                      workers=len(swapped))
+        return {"type": "reloaded", "version": version,
+                "workers": results}, None
+
     # -- front server -------------------------------------------------------
 
     def _make_server(self):
@@ -701,6 +741,8 @@ class Router:
                             "workers": router._worker_states(),
                             "prometheus": router.metrics_.prometheus_text(),
                         }, None
+                    elif kind == "reload":
+                        resp, out = router._handle_reload(header)
                     else:
                         resp, out = {"type": "error", "error": "Rpc",
                                      "message": "unknown message type %r"
@@ -799,6 +841,7 @@ class RouterClient:
         "DeadlineRefused": DeadlineExceededError,
         "WorkerFailed": WorkerFailedError,
         "RouterShutdown": RouterShutdownError,
+        "ReloadFailed": WorkerFailedError,
         "Rpc": rpc.RpcError,
     }
 
@@ -882,6 +925,19 @@ class RouterClient:
             self._raise_typed(header)
         return {"snapshot": header["snapshot"],
                 "workers": header["workers"]}
+
+    def reload(self, ckpt_dir, version=None):
+        """Hot-swap every worker to a published checkpoint version
+        (``version=None`` = each worker's newest intact). Returns the
+        router's reply dict: ``{"version": N, "workers": [...]}`` with
+        per-worker verdicts. Raises :class:`WorkerFailedError` (kind
+        ``ReloadFailed``) only when no worker swapped."""
+        header, _ = self._roundtrip(
+            {"type": "reload", "dir": ckpt_dir, "version": version}, None)
+        if header.get("type") == "error":
+            self._raise_typed(header)
+        return {"version": header.get("version"),
+                "workers": header.get("workers", [])}
 
     def prometheus(self):
         """Scrape the router's Prometheus exposition text (ping path)."""

@@ -27,6 +27,13 @@ Protocol (one ``rpc`` frame in, one out, persistent connections):
     already spent is REFUSED before it touches the engine
     (``error: "DeadlineRefused"``) — expired work must not occupy a
     batch slot anywhere on the path.
+  * ``{"type": "reload", "dir": <checkpoint dir>, "version": <n-or-null>}``
+    -> ``{"type": "reloaded", "version": N}`` — the streaming publish
+    plane's hot-swap verb: stages a CRC-verified load of the newest
+    intact (or given) ``checkpoint_<n>`` and flips every engine replica
+    to the new parameters between micro-batches (in-flight requests
+    finish on the old weights). A corrupt/mismatched version answers a
+    typed ``{"error": "ReloadFailed"}`` and the old model keeps serving.
   * ``{"type": "shutdown"}`` -> acked, then the process drains and exits.
 
 ``--model`` takes a ``save_inference_model`` directory or a
@@ -162,6 +169,24 @@ def _handle_infer(state, header, arrays):
     return {"type": "result", "n_out": len(outs)}, out_arrays
 
 
+def _handle_reload(state, header):
+    """Hot-swap the engine to a published checkpoint version. Failure is
+    typed, never fatal: the worker keeps serving the old weights and the
+    caller (publisher/router) decides on fallback."""
+    ckpt_dir = header.get("dir")
+    if not ckpt_dir:
+        return {"type": "error", "error": "Rpc",
+                "message": "reload needs a 'dir' field"}
+    try:
+        version = state.engine.reload(ckpt_dir,
+                                      version=header.get("version"))
+    except Exception as e:
+        return {"type": "error", "error": "ReloadFailed",
+                "message": "%s: %s" % (type(e).__name__, e)}
+    return {"type": "reloaded", "version": version,
+            "swap_count": state.engine.swap_count}
+
+
 def _make_server(host, port, state):
     class Handler(socketserver.BaseRequestHandler):
         def handle(self):
@@ -196,6 +221,8 @@ def _make_server(host, port, state):
                         "prometheus":
                             state.engine.metrics_.prometheus_text(),
                     }, None
+                elif kind == "reload":
+                    resp, out = _handle_reload(state, header), None
                 elif kind == "shutdown":
                     resp, out = {"type": "ok"}, None
                 else:

@@ -1,0 +1,216 @@
+"""Model-swap plane: watch the checkpoint dir, hot-swap live serving.
+
+Reference: pslib/Downpour's table server shipping fresh parameters to the
+serving fleet without restarts. Here the trainer publishes versioned
+checkpoints (``checkpoint_<n>`` + atomic ``latest`` marker) and this
+watcher detects them and drives the ``reload`` verb — on an in-process
+:class:`~paddle_tpu.serving.ServingEngine` directly, or across a whole
+router fleet through :class:`RouterTarget`.
+
+Failure story: every staged load is CRC-verified; a corrupt newest
+version is recorded (``publish.bad_version`` flight event), counted
+against a :class:`~paddle_tpu.reliability.policy.CircuitBreaker` —
+repeated bad publishes OPEN it and the publisher stops hammering the
+checkpoint dir until the reset timeout — and serving falls back to the
+previous intact version. The served version is *pinned*
+(``checkpoint.pin_version``) so the trainer's retention GC can never
+delete the weights a serving process is using.
+
+Staleness: ``serve-version lag`` (how many publishes behind the fleet
+is) and ``staleness seconds`` (publish-to-swap latency, sampled per
+swap) export as gauges on the streaming registry.
+"""
+
+import os
+import threading
+import time
+import warnings
+
+from .. import checkpoint
+from ..obs import flight
+from ..reliability.policy import CircuitBreaker
+from .stream import REGISTRY
+
+__all__ = ["ModelPublisher", "RouterTarget"]
+
+
+class RouterTarget:
+    """Adapts a :class:`~paddle_tpu.serving.RouterClient` to the
+    publisher's target protocol (``reload(ckpt_dir, version=) -> int``):
+    the swap broadcasts to every worker in the fleet."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def reload(self, ckpt_dir, version=None):
+        return self.client.reload(ckpt_dir, version=version)["version"]
+
+
+class ModelPublisher:
+    """Poll ``ckpt_dir`` for fresh versions and hot-swap ``target``.
+
+    ``target`` is anything with ``reload(ckpt_dir, version=) -> version``
+    — a ``ServingEngine`` fits directly; wrap a ``RouterClient`` in
+    :class:`RouterTarget`. Drive it with ``poll_once()`` (deterministic,
+    test/fake-clock friendly) or ``start()``/``stop()`` (background
+    watcher thread at ``poll_interval_s``)."""
+
+    def __init__(self, ckpt_dir, target, poll_interval_s=0.2,
+                 breaker=None, registry=None, clock=None, sleep=None,
+                 pin_owner=None, pin=True):
+        self.ckpt_dir = ckpt_dir
+        self.target = target
+        self.poll_interval_s = float(poll_interval_s)
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=3, reset_timeout_s=10 * poll_interval_s,
+            clock=clock, name="publisher")
+        self._clock = clock or time.monotonic
+        self._sleep = sleep or time.sleep
+        self.pin = bool(pin)
+        self.pin_owner = pin_owner or ("serving-%d" % os.getpid())
+        self.published_version = None
+        self.served_version = None
+        self.swap_count = 0
+        self.bad_publishes = 0
+        self.staleness_samples = []  # publish-to-swap latency, seconds
+        self._staleness_s = 0.0
+        self._last_versions = []
+        self._stop = threading.Event()
+        self._thread = None
+        reg = registry if registry is not None else REGISTRY
+        self.registry = reg
+        self._c_swaps = reg.counter(
+            "paddle_tpu_stream_swaps_total",
+            "successful live model hot-swaps")
+        self._c_bad = reg.counter(
+            "paddle_tpu_stream_bad_publishes_total",
+            "published versions that failed the staged CRC load/swap")
+        reg.gauge("paddle_tpu_stream_published_version",
+                  "newest complete checkpoint version in the publish dir",
+                  fn=lambda: -1 if self.published_version is None
+                  else self.published_version)
+        reg.gauge("paddle_tpu_stream_served_version",
+                  "checkpoint version the serving tier runs on",
+                  fn=lambda: -1 if self.served_version is None
+                  else self.served_version)
+        reg.gauge("paddle_tpu_stream_serve_version_lag",
+                  "publishes the serving tier is behind the trainer",
+                  fn=self.version_lag)
+        reg.gauge("paddle_tpu_stream_staleness_seconds",
+                  "age of the newest unserved publish (0 when current)",
+                  fn=lambda: self._staleness_s)
+
+    # -- staleness -----------------------------------------------------------
+    def version_lag(self):
+        """How many complete publishes the serving tier is behind (0 =
+        serving the newest; N = N fresher versions exist)."""
+        vs = self._last_versions
+        if not vs:
+            return 0
+        if self.served_version is None:
+            return len(vs)
+        try:
+            return vs.index(self.served_version)
+        except ValueError:
+            return len(vs)  # served version already GC'd: fully stale
+
+    def _publish_age_s(self, version):
+        try:
+            mt = os.path.getmtime(os.path.join(
+                self.ckpt_dir, "checkpoint_%d" % version,
+                checkpoint._MANIFEST))
+            return max(0.0, time.time() - mt)
+        except OSError:
+            return 0.0
+
+    # -- the watcher ---------------------------------------------------------
+    def poll_once(self):
+        """One detection + swap attempt. Returns the version swapped to,
+        or None (nothing new / breaker open / nothing intact)."""
+        versions = checkpoint.candidate_versions(self.ckpt_dir)
+        self._last_versions = versions
+        if not versions:
+            return None
+        self.published_version = versions[0]
+        if self.served_version == versions[0]:
+            self._staleness_s = 0.0
+            return None
+        self._staleness_s = self._publish_age_s(versions[0])
+        if not self.breaker.allow():
+            return None  # repeated bad publishes: stop hammering
+        swapped = None
+        for v in versions:  # newest first; walk back past bad versions
+            if self.served_version is not None \
+                    and v == self.served_version:
+                break  # nothing fresher is intact: keep serving current
+            try:
+                got = self.target.reload(self.ckpt_dir, version=v)
+                swapped = v if got is None else got
+                break
+            except Exception as e:  # noqa: BLE001 — fall back, stay up
+                self.bad_publishes += 1
+                self._c_bad.inc()
+                tripped = self.breaker.record_failure()
+                flight.record("publish.bad_version", version=v,
+                              error=type(e).__name__, tripped=tripped)
+                warnings.warn(
+                    "publisher: version %d failed staged load/swap (%s: "
+                    "%s); falling back to the previous intact version"
+                    % (v, type(e).__name__, e), RuntimeWarning)
+        if swapped is None:
+            return None
+        if swapped == versions[0]:
+            # only serving the NEWEST publish closes the breaker: a
+            # fallback swap keeps the bad-publish streak alive
+            self.breaker.record_success()
+            self._staleness_s = 0.0
+        prev = self.served_version
+        self.served_version = swapped
+        self.swap_count += 1
+        self._c_swaps.inc()
+        self.staleness_samples.append(self._publish_age_s(swapped))
+        if self.pin:
+            try:
+                checkpoint.pin_version(self.ckpt_dir, swapped,
+                                       owner=self.pin_owner)
+            except FileNotFoundError:
+                pass  # GC raced the swap; the version is gone from disk
+            if prev is not None and prev != swapped:
+                checkpoint.unpin_version(self.ckpt_dir, prev,
+                                         owner=self.pin_owner)
+        return swapped
+
+    # -- background thread ---------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paddle-tpu-publisher")
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — the watcher survives
+                warnings.warn("publisher poll failed: %s: %s"
+                              % (type(e).__name__, e), RuntimeWarning)
+            self._sleep(self.poll_interval_s)
+
+    def stop(self, unpin=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+        if unpin and self.pin and self.served_version is not None:
+            checkpoint.unpin_version(self.ckpt_dir, self.served_version,
+                                     owner=self.pin_owner)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

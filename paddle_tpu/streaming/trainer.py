@@ -1,0 +1,280 @@
+"""Long-running DeepFM trainer over a live record stream.
+
+Reference: the pslib/Downpour trainer loop — ``async_executor.cc`` workers
+consume click logs forever while the table server ships fresh parameters
+to serving. Here the trainer consumes a :class:`~.stream.RecordStream`,
+tracks an accuracy proxy on held-out rows, and periodically *publishes*:
+a CRC-verified versioned checkpoint (``checkpoint.save_checkpoint``)
+whose write runs on a background thread — the device->host snapshot is
+synchronous (the executor donates state buffers on the next step) but
+the training loop never blocks on the disk write, and the atomic
+``latest`` marker lands last so the swap plane can only ever observe
+complete versions.
+
+Fault site ``checkpoint.publish`` trips once per publish attempt:
+``error`` models a failed publish (counted, training continues — a
+long-running trainer must not die to one), ``corrupt`` damages the
+landed version so the swap plane's fallback-to-previous-intact path can
+be drilled end to end.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+from .. import checkpoint
+from ..core.executor import Executor, Scope, scope_guard
+from ..core.framework import Program, program_guard
+from ..core import unique_name
+from ..data.data_feed import DataFeedDesc
+from ..obs import flight
+from ..reliability import faults
+from .stream import RecordStream, StreamIngester, write_records
+
+__all__ = ["StreamingTrainer", "feed_desc", "synthesize_stream_files"]
+
+TRAINER_READY_PREFIX = "PADDLE_TPU_TRAINER_READY "
+
+
+def feed_desc(num_fields=4, dense_dim=4, batch_size=16):
+    """The DeepFM stream schema: one record = concatenated dense slots."""
+    return DataFeedDesc([("feat_ids", (num_fields,), "int64"),
+                         ("dense_value", (dense_dim,), "float32"),
+                         ("label", (1,), "int64")], batch_size=batch_size)
+
+
+def synthesize_stream_files(data_dir, num_fields=4, sparse_feature_dim=64,
+                            dense_dim=4, n_files=1, rows_per_file=256,
+                            start_index=0, seed=0, chunk_rows=64):
+    """Write learnable synthetic CTR rows as recordio files (pure-Python
+    writer — no native toolchain needed). The labeling rule is a fixed
+    function of ``seed``, so successive calls with increasing
+    ``start_index`` extend the SAME distribution — the producer side of a
+    tail-follow drill. Returns the file paths written."""
+    desc = feed_desc(num_fields, dense_dim, batch_size=1)
+    rule = np.random.RandomState(seed)
+    w_id = rule.normal(0.0, 1.0, sparse_feature_dim)
+    w_dense = rule.normal(0.0, 1.0, dense_dim)
+    thresh = 0.5 * float(w_dense.sum())  # E[logit] -> ~balanced labels
+    os.makedirs(data_dir, exist_ok=True)
+    paths = []
+    for fi in range(start_index, start_index + n_files):
+        path = os.path.join(data_dir, "part-%05d.recordio" % fi)
+        rng = np.random.RandomState(seed * 7919 + 1000 + fi)
+        recs = []
+        for _ in range(rows_per_file):
+            ids = rng.randint(0, sparse_feature_dim, num_fields)
+            dense = rng.uniform(0.0, 1.0, dense_dim).astype("f4")
+            logit = float(w_id[ids].sum()) + float(dense @ w_dense)
+            recs.append(desc.serialize({
+                "feat_ids": ids, "dense_value": dense,
+                "label": [int(logit > thresh)]}))
+            if len(recs) >= chunk_rows:
+                write_records(path, recs)
+                recs = []
+        if recs:
+            write_records(path, recs)
+        paths.append(path)
+    return paths
+
+
+class StreamingTrainer:
+    """DeepFM trainer consuming a record stream, publishing versioned
+    checkpoints every ``publish_every_steps`` without blocking the loop.
+
+    ``ckpt_dir`` receives ``checkpoint_<n>`` versions (retention-GC'd to
+    ``max_versions``, pinned versions excepted) and ``serve/`` — an
+    inference-model export written once at startup that a ServingEngine
+    loads; subsequent publishes only ship parameter deltas via the
+    checkpoint versions the swap plane stages.
+
+    The first ``holdout_batches`` full batches off the stream are HELD
+    OUT (never trained on) and scored at every publish —
+    ``last_eval_loss`` is the accuracy proxy the soak test watches
+    improve across hot swaps."""
+
+    def __init__(self, ckpt_dir, num_fields=4, sparse_feature_dim=64,
+                 embedding_size=8, dense_dim=4, hidden_sizes=(32,),
+                 batch_size=16, learning_rate=0.05, publish_every_steps=50,
+                 max_versions=4, holdout_batches=2, seed=7, place=None):
+        from ..models.deepfm import deepfm
+        from .. import optimizer
+
+        self.ckpt_dir = ckpt_dir
+        self.serve_dir = os.path.join(ckpt_dir, "serve")
+        self.publish_every_steps = int(publish_every_steps)
+        self.max_versions = max_versions
+        self.holdout_batches = int(holdout_batches)
+        self.data_feed = feed_desc(num_fields, dense_dim, batch_size)
+        self.holdout = []
+        self.step = 0
+        self.publishes = 0
+        self.publish_failures = 0
+        self.last_train_loss = None
+        self.last_eval_loss = None
+        self._writer = None
+
+        self.main, self.startup = Program(), Program()
+        self.main.random_seed = self.startup.random_seed = int(seed)
+        self.scope = Scope()
+        with program_guard(self.main, self.startup), \
+                scope_guard(self.scope):
+            unique_name.switch()
+            spec = deepfm(sparse_feature_dim=sparse_feature_dim,
+                          num_fields=num_fields,
+                          embedding_size=embedding_size,
+                          dense_dim=dense_dim, hidden_sizes=hidden_sizes)
+            self.loss = spec.loss
+            self.prob = spec.fetches["prob"]
+            optimizer.Adam(learning_rate=learning_rate).minimize(self.loss)
+            self.exe = Executor(place)
+            self.exe.run(self.startup)
+            # forward-only clone for held-out scoring: pruning to the loss
+            # drops the backward + Adam ops, so scoring never trains
+            eval_prog = self.main.clone(for_test=True)
+            eval_loss = eval_prog.global_block().var(self.loss.name)
+            self.eval_prog = eval_prog.prune([eval_loss])
+            self.eval_loss = self.eval_prog.global_block().var(
+                self.loss.name)
+        self._export_serve_dir()
+
+    def _export_serve_dir(self):
+        from .. import io
+
+        with scope_guard(self.scope):
+            io.save_inference_model(
+                self.serve_dir, ["feat_ids", "dense_value"], [self.prob],
+                self.exe, main_program=self.main)
+
+    # -- accuracy proxy ------------------------------------------------------
+    def eval_holdout(self):
+        """Mean loss over the held-out batches (lower = better)."""
+        if not self.holdout:
+            return None
+        losses = []
+        for feed in self.holdout:
+            v, = self.exe.run(self.eval_prog, feed=feed,
+                              fetch_list=[self.eval_loss], scope=self.scope,
+                              return_numpy=False)
+            losses.append(float(np.asarray(v)))
+        return float(np.mean(losses))
+
+    # -- publish -------------------------------------------------------------
+    def publish(self):
+        """Snapshot + async-write one checkpoint version. Never raises:
+        a failed publish is counted (``publish_failures``), recorded to
+        the flight ring, and training continues."""
+        # surface a PREVIOUS publish's write failure now (non-blocking:
+        # only a finished writer is examined)
+        if self._writer is not None and self._writer.done() \
+                and self._writer.error is not None:
+            self.publish_failures += 1
+            flight.record("publish.fail", step=self.step,
+                          error=type(self._writer.error).__name__)
+            self._writer = None
+        self.last_eval_loss = self.eval_holdout()
+        try:
+            # fault site: 'error' = the publish path dying mid-flight,
+            # 'corrupt' = a bad version landing (swap-plane fallback drill)
+            mode = faults.trip("checkpoint.publish")
+            writer = checkpoint.save_checkpoint(
+                self.exe, self.ckpt_dir, main_program=self.main,
+                scope=self.scope, async_write=True,
+                max_versions=self.max_versions,
+                extra_meta={"step": self.step,
+                            "eval_loss": self.last_eval_loss})
+        except Exception as e:  # noqa: BLE001 — a trainer outlives publishes
+            self.publish_failures += 1
+            flight.record("publish.fail", step=self.step,
+                          error=type(e).__name__)
+            return None
+        version = int(os.path.basename(writer.path).split("_")[1])
+        if mode == "corrupt":
+            writer.wait()  # only the injected-corruption path blocks
+            checkpoint._flip_byte(
+                os.path.join(writer.path, "replicated.npz"))
+        self._writer = writer
+        self.publishes += 1
+        flight.record("publish.version", version=version, step=self.step,
+                      eval_loss=self.last_eval_loss)
+        return writer
+
+    # -- the loop ------------------------------------------------------------
+    def run(self, stream, max_steps=None, max_bad_records=0,
+            on_publish=None):
+        """Consume ``stream`` until it closes (or ``max_steps`` training
+        steps ran), publishing every ``publish_every_steps``. Returns the
+        number of training steps executed."""
+        ing = StreamIngester(stream, self.data_feed,
+                             max_bad_records=max_bad_records)
+        for feed in ing.batches():
+            if len(self.holdout) < self.holdout_batches:
+                self.holdout.append(feed)
+                continue
+            v, = self.exe.run(self.main, feed=feed, fetch_list=[self.loss],
+                              scope=self.scope, return_numpy=False)
+            self.last_train_loss = float(np.asarray(v))
+            self.step += 1
+            if self.publish_every_steps \
+                    and self.step % self.publish_every_steps == 0:
+                self.publish()
+                if on_publish is not None:
+                    on_publish(self)
+            if max_steps is not None and self.step >= max_steps:
+                break
+        return self.step
+
+    def close(self):
+        """Join the in-flight checkpoint write (surfacing its error)."""
+        if self._writer is not None:
+            self._writer.wait()
+            self._writer = None
+
+
+def main(argv=None):
+    """CLI for drills: tail-follow ``--data-dir`` and train, publishing
+    into ``--ckpt-dir``. Prints a READY line (with the serve dir) once
+    the model is built and exported, so a parent process can time its
+    kill signals against the publish cadence."""
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--data-dir", required=True)
+    p.add_argument("--ckpt-dir", required=True)
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--publish-every", type=int, default=25)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--max-versions", type=int, default=4)
+    p.add_argument("--sparse-dim", type=int, default=64)
+    p.add_argument("--poll-interval", type=float, default=0.05)
+    p.add_argument("--seed", type=int, default=7)
+    args = p.parse_args(argv)
+
+    faults.maybe_install_from_env()
+    flight.install()
+    trainer = StreamingTrainer(
+        args.ckpt_dir, batch_size=args.batch_size,
+        publish_every_steps=args.publish_every,
+        max_versions=args.max_versions,
+        sparse_feature_dim=args.sparse_dim, seed=args.seed)
+    print(TRAINER_READY_PREFIX + json.dumps(
+        {"pid": os.getpid(), "serve_dir": trainer.serve_dir}), flush=True)
+    stream = RecordStream(args.data_dir,
+                          poll_interval_s=args.poll_interval)
+    t0 = time.monotonic()
+    steps = trainer.run(stream, max_steps=args.steps)
+    trainer.close()
+    flight.maybe_dump(reason="trainer-exit")
+    print(json.dumps({
+        "steps": steps, "publishes": trainer.publishes,
+        "publish_failures": trainer.publish_failures,
+        "eval_loss": trainer.last_eval_loss,
+        "rows_per_sec": stream.rows_per_sec(),
+        "elapsed_s": round(time.monotonic() - t0, 3)}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -103,6 +103,56 @@ def test_serving_bench_record(monkeypatch):
                           "span_count": 0, "mfu_vs_model": None}
 
 
+def test_streaming_bench_record(monkeypatch):
+    """The streaming train-to-serve harness emits the ISSUE 18 record
+    shape: ingest rows/sec headline, publish period, live swap count,
+    publish-to-swap staleness p50/p99, and the serving p99 over requests
+    in flight during a swap — with the CPU run carrying its honest
+    negative-result throughput claim."""
+    import bench
+
+    monkeypatch.setenv("BENCH_STREAMING_ROWS", "600")
+    monkeypatch.setenv("BENCH_STREAMING_BATCH", "16")
+    monkeypatch.setenv("BENCH_STREAMING_PUBLISH_EVERY", "10")
+    monkeypatch.setenv("BENCH_STREAMING_REPLICAS", "2")
+    rec = bench._bench_streaming(on_tpu=False)
+    assert rec["metric"] == "streaming_ingest_rows_per_sec"
+    assert rec["unit"] == "rows/sec"
+    assert rec["value"] > 0
+    cfg = rec["config"]
+    assert cfg["rows"] == 600 and cfg["batch"] == 16
+    assert cfg["publish_every_steps"] == 10 and cfg["replicas"] == 2
+    assert cfg["steps"] > 0 and cfg["p99_budget_s"] > 0
+    # the swap plane actually ran: publishes happened on a cadence and
+    # at least one landed as a LIVE hot-swap with a staleness sample
+    assert rec["publish_period_s_mean"] is not None
+    assert rec["publish_period_s_mean"] > 0
+    assert rec["swap_count"] >= 1
+    assert rec["staleness_p50_s"] is not None
+    assert rec["staleness_p50_s"] >= 0
+    assert rec["staleness_p99_s"] >= rec["staleness_p50_s"]
+    # serving stayed up throughout; during-swap p99 is the zero-drop
+    # hot-swap claim in numbers (None only if no request overlapped a
+    # swap window — then the overall p99 still pins liveness)
+    assert rec["serving_p99_s"] is not None and rec["serving_p99_s"] > 0
+    assert (rec["serving_p99_during_swap_s"] is None
+            or rec["serving_p99_during_swap_s"] > 0)
+    assert rec["during_swap_requests"] >= 0
+    prox = rec["accuracy_proxy"]
+    assert prox["eval_loss_first"] is not None
+    assert prox["eval_loss_last"] is not None
+    assert prox["improved"] in (True, False)
+    # healthy run: every reliability counter is zero
+    rel = rec["reliability"]
+    assert set(rel) == {"bad_publishes", "publish_failures",
+                        "bad_chunks", "serving_errors"}
+    assert all(v == 0 for v in rel.values()), rel
+    # the CPU record says out loud that rows/sec is not a TPU claim
+    assert rec["throughput_claim"].startswith("negative-result on CPU")
+    assert rec["obs"] == {"traced": False, "trace_path": None,
+                          "span_count": 0, "mfu_vs_model": None}
+
+
 def test_seq_override_metric_suffix(monkeypatch):
     import bench
 

@@ -222,3 +222,92 @@ def test_trim_keeps_most_recently_written(tmp_path):
     _trim(str(ckpt), keep=2, grace_seconds=60.0)
     kept = sorted(d for d in os.listdir(ckpt))
     assert kept == ["checkpoint_1100", "checkpoint_3000"], kept
+
+
+def _tiny_saver(tmp_path, name):
+    """(ckpt_dir, save_fn, main, scope) over a 2-param model."""
+    ckpt = str(tmp_path / name)
+    main, startup = fluid.Program(), fluid.Program()
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        x = fluid.layers.data("x", shape=[4])
+        loss = fluid.layers.mean(fluid.layers.fc(x, size=2))
+        fluid.optimizer.SGD(0.1).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+
+    def save(**kw):
+        return fluid.io.save_checkpoint(exe, ckpt, main_program=main,
+                                        scope=scope, async_write=False,
+                                        **kw)
+
+    return ckpt, save, main, scope
+
+
+def test_retention_gc_skips_pinned_versions(tmp_path):
+    """``max_versions=N`` garbage-collects old publishes — except the one
+    a serving process pinned, which survives any number of saves and is
+    trimmed again once unpinned."""
+    from paddle_tpu import checkpoint
+
+    ckpt, save, _main, _scope = _tiny_saver(tmp_path, "pin")
+    save(max_versions=2)
+    save(max_versions=2)
+    checkpoint.pin_version(ckpt, 0, owner="serving-a")
+    assert checkpoint.pinned_versions(ckpt) == {0}
+    for _ in range(3):
+        save(max_versions=2)
+    kept = sorted(int(d.split("_")[1]) for d in os.listdir(ckpt)
+                  if d.startswith("checkpoint_"))
+    # 2 newest + the pinned one (pins do not count against the budget)
+    assert kept == [0, 3, 4]
+    # unpin: the stale version no longer outlives the next save's GC
+    checkpoint.unpin_version(ckpt, 0, owner="serving-a")
+    assert checkpoint.pinned_versions(ckpt) == set()
+    save(max_versions=2)
+    kept = sorted(int(d.split("_")[1]) for d in os.listdir(ckpt)
+                  if d.startswith("checkpoint_"))
+    assert kept == [4, 5]
+    # pinning a GC'd version is an error; unpinning twice is a no-op
+    with pytest.raises(FileNotFoundError):
+        checkpoint.pin_version(ckpt, 0)
+    checkpoint.unpin_version(ckpt, 0, owner="serving-a")
+
+
+def test_pin_owners_are_independent(tmp_path):
+    from paddle_tpu import checkpoint
+
+    ckpt, save, _main, _scope = _tiny_saver(tmp_path, "own")
+    save(max_versions=1)
+    checkpoint.pin_version(ckpt, 0, owner="a")
+    checkpoint.pin_version(ckpt, 0, owner="b")
+    checkpoint.unpin_version(ckpt, 0, owner="a")
+    assert checkpoint.pinned_versions(ckpt) == {0}  # b still holds it
+    save(max_versions=1)
+    assert os.path.isdir(os.path.join(ckpt, "checkpoint_0"))
+
+
+def test_load_staged_falls_back_past_corrupt_newest(tmp_path):
+    """The swap plane's staged read: a corrupt newest version warns and
+    stages the previous intact one; an EXPLICIT version raises instead."""
+    from paddle_tpu import checkpoint
+
+    ckpt, save, main, scope = _tiny_saver(tmp_path, "stage")
+    save(extra_meta={"step": 1})
+    w = save(extra_meta={"step": 2})
+    checkpoint._flip_byte(os.path.join(w.path, "replicated.npz"))
+    with pytest.warns(UserWarning, match="staging the previous"):
+        v, updates, extra = checkpoint.load_staged(ckpt, main)
+    assert v == 0 and extra == {"step": 1}
+    compared = 0
+    for name, val in updates:
+        if name.startswith("@"):  # RNG key: not a swap-plane param
+            continue
+        np.testing.assert_allclose(np.asarray(val), scope.numpy(name))
+        compared += 1
+    assert compared >= 2  # fc weight + bias actually staged
+    import zipfile
+
+    with pytest.raises((IOError, ValueError, zipfile.BadZipFile)):
+        checkpoint.load_staged(ckpt, main, version=1)

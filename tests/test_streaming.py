@@ -1,0 +1,515 @@
+"""Streaming continuous learning: tail-follow ingest -> trainer ->
+versioned publish -> live hot-swap (``paddle_tpu/streaming/``).
+
+Covers the ISSUE-18 tentpole: tail-follow edge cases (partial trailing
+chunk resumes, rotation mid-read, CRC corruption + ``max_bad_records``),
+the trainer's non-blocking publish with the ``checkpoint.publish`` fault
+site, the publisher's corrupt-version fallback + breaker, the engine's
+zero-drop hot-swap, the router fleet ``reload`` verb, and the fast
+fake-clock soak (the slow full-router soak lives at the bottom)."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as fluid
+from paddle_tpu import checkpoint, native, serving, streaming
+from paddle_tpu.obs import flight
+from paddle_tpu.reliability import faults
+from paddle_tpu.streaming.stream import TailReader, encode_chunk
+
+
+def _drained(data_dir, **kw):
+    """A stream over ``data_dir`` that drains what's there and stops."""
+    s = streaming.RecordStream(data_dir, poll_interval_s=0.0,
+                               sleep=lambda _t: None, **kw)
+    s.close()
+    return s
+
+
+# -- wire format + tail-follow edge cases -----------------------------------
+
+def test_pure_python_writer_roundtrip(tmp_path):
+    path = str(tmp_path / "part-00000.recordio")
+    recs = [b"alpha", b"", b"x" * 300]
+    streaming.write_records(path, recs)
+    streaming.write_records(path, [b"beta"])  # second chunk appends
+    r = TailReader(path)
+    got, pending = r.poll(final=True)
+    assert got == recs + [b"beta"] and not pending
+    assert r.bad_chunks == 0 and r.records_read == 4
+
+
+@pytest.mark.skipif(not native.native_available(),
+                    reason="native toolchain unavailable")
+def test_pure_python_writer_native_reader_compat(tmp_path):
+    path = str(tmp_path / "part-00000.recordio")
+    streaming.write_records(path, [b"one", b"two"])
+    assert list(native.RecordIOReader(path)) == [b"one", b"two"]
+
+
+def test_partial_record_at_eof_resumes(tmp_path):
+    path = str(tmp_path / "part-00000.recordio")
+    chunk = encode_chunk([b"rec-a", b"rec-b"])
+    # land the header + half the payload: a writer mid-flush
+    with open(path, "wb") as f:
+        f.write(chunk[:20])
+    r = TailReader(path)
+    got, pending = r.poll()
+    assert got == [] and pending  # waits, does NOT count corruption
+    assert r.bad_chunks == 0
+    with open(path, "ab") as f:  # the rest lands
+        f.write(chunk[20:])
+    got, pending = r.poll()
+    assert got == [b"rec-a", b"rec-b"] and not pending
+    # partial HEADER (fewer than 16 bytes) also waits
+    with open(path, "ab") as f:
+        f.write(encode_chunk([b"rec-c"])[:7])
+    got, pending = r.poll()
+    assert got == [] and pending and r.bad_chunks == 0
+
+
+def test_rotation_mid_read(tmp_path):
+    data = str(tmp_path)
+    p0 = os.path.join(data, "part-00000.recordio")
+    streaming.write_records(p0, [b"f0-r0", b"f0-r1"])
+    stream = streaming.RecordStream(data, poll_interval_s=0.0,
+                                    sleep=lambda _t: None)
+    it = stream.records()
+    assert next(it) == b"f0-r0" and next(it) == b"f0-r1"
+    # rotate: new file appears while the old one has a TORN tail — the
+    # rotation contract seals part-00000, so the tear is counted and the
+    # stream moves on without stalling
+    with open(p0, "ab") as f:
+        f.write(encode_chunk([b"torn"])[:9])
+    streaming.write_records(os.path.join(data, "part-00001.recordio"),
+                            [b"f1-r0"])
+    assert next(it) == b"f1-r0"
+    assert stream.bad_chunks == 1
+    stream.close()
+    assert list(it) == []
+
+
+def test_corrupt_chunk_skipped_next_chunk_survives(tmp_path):
+    path = str(tmp_path / "part-00000.recordio")
+    c1, c2 = encode_chunk([b"bad-chunk"]), encode_chunk([b"good"])
+    damaged = bytearray(c1)
+    damaged[len(c1) // 2] ^= 0xFF  # payload byte flip -> CRC mismatch
+    with open(path, "wb") as f:
+        f.write(bytes(damaged) + c2)
+    r = TailReader(path)
+    got, _ = r.poll(final=True)
+    assert got == [b"good"] and r.bad_chunks == 1
+
+
+def test_stream_tail_fault_site(tmp_path):
+    data = str(tmp_path)
+    streaming.write_records(os.path.join(data, "part-00000.recordio"),
+                            [b"r0", b"r1"])
+    # error kills the tailer on the chosen poll
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "stream.tail:error@2")):
+        stream = _drained(data)
+        it = stream.records()
+        assert next(it) == b"r0" and next(it) == b"r1"
+        with pytest.raises(faults.InjectedFault):
+            next(it)
+    # corrupt damages the first record the poll delivers
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "stream.tail:corrupt@1")):
+        got = list(_drained(data).records())
+    assert got[0] != b"r0" and got[1] == b"r1"
+
+
+def test_ingester_max_bad_records_with_injected_corruption(tmp_path):
+    desc = fluid.DataFeedDesc([("x", (4,), "float32")], batch_size=2)
+    data = str(tmp_path)
+    rows = [desc.serialize({"x": np.full(4, i, "f4")}) for i in range(8)]
+    streaming.write_records(os.path.join(data, "part-00000.recordio"), rows)
+    # recordio.read corruption on 2 records, bound 2: skipped + counted,
+    # remaining 6 records still make 3 full batches
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "recordio.read:corrupt@2;recordio.read:corrupt@5")):
+        ing = streaming.StreamIngester(_drained(data), desc,
+                                       max_bad_records=2)
+        with pytest.warns(RuntimeWarning, match="skipped 2"):
+            batches = list(ing.batches())
+    assert len(batches) == 3 and ing.bad_records == 2
+    # same damage, bound 1: the second corrupt record aborts
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "recordio.read:corrupt@2;recordio.read:corrupt@5")):
+        ing = streaming.StreamIngester(_drained(data), desc,
+                                       max_bad_records=1)
+        with pytest.raises(ValueError, match="max_bad_records"):
+            list(ing.batches())
+
+
+def test_ingest_throughput_gauge_exported(tmp_path):
+    data = str(tmp_path)
+    streaming.write_records(os.path.join(data, "part-00000.recordio"),
+                            [b"a", b"b"])
+    stream = _drained(data)
+    list(stream.records())
+    text = streaming.REGISTRY.prometheus_text()
+    assert "paddle_tpu_stream_ingest_rows_per_sec" in text
+    assert "paddle_tpu_stream_records_total" in text
+
+
+# -- AsyncExecutor fed from a live stream (no native toolchain needed) ------
+
+def test_async_executor_run_from_stream(tmp_path):
+    desc = fluid.DataFeedDesc([("x", (8,), "float32"),
+                               ("y", (1,), "int64")], batch_size=16)
+    rng = np.random.RandomState(0)
+    w = rng.normal(0, 1, (8, 3)).astype("f4")
+    data = str(tmp_path)
+    rows = []
+    for _ in range(320):
+        x = rng.normal(0, 1, 8).astype("f4")
+        rows.append(desc.serialize({"x": x, "y": [np.argmax(x @ w)]}))
+    streaming.write_records(os.path.join(data, "part-00000.recordio"), rows)
+
+    main, startup = fluid.Program(), fluid.Program()
+    main.random_seed = startup.random_seed = 5
+    scope = fluid.Scope()
+    with fluid.program_guard(main, startup), fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        x = fluid.layers.data("x", shape=[8])
+        y = fluid.layers.data("y", shape=[1], dtype="int64")
+        h = fluid.layers.fc(x, size=32, act="relu")
+        loss = fluid.layers.mean(fluid.layers.softmax_with_cross_entropy(
+            fluid.layers.fc(h, size=3), y))
+        fluid.optimizer.Adam(learning_rate=0.02).minimize(loss)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        async_exe = fluid.AsyncExecutor()
+        seen = []
+        steps = async_exe.run_from_stream(
+            main, desc, _drained(data), fetch=[loss], scope=scope,
+            on_step=lambda _s, vals: seen.append(float(np.asarray(vals[0]))))
+    assert steps == 20 and len(seen) == 20
+    assert seen[-1] < seen[0]
+
+
+# -- checkpoint publish + staged load + hot-swap ----------------------------
+
+@pytest.fixture(scope="module")
+def trained(tmp_path_factory):
+    """One trained-and-published setup shared by the swap tests: data,
+    a trainer that ran 15 steps publishing every 5, and its serve dir."""
+    tmp = tmp_path_factory.mktemp("streaming")
+    data_dir, ckpt_dir = str(tmp / "data"), str(tmp / "ckpt")
+    streaming.synthesize_stream_files(data_dir, n_files=2,
+                                      rows_per_file=200, seed=3)
+    trainer = streaming.StreamingTrainer(
+        ckpt_dir, batch_size=16, publish_every_steps=5, max_versions=3,
+        hidden_sizes=(16,), holdout_batches=2)
+    trainer.run(_drained(data_dir), max_steps=15)
+    trainer.close()
+    return trainer, data_dir, ckpt_dir
+
+
+def test_trainer_publishes_versions_nonblocking(trained):
+    trainer, _data, ckpt_dir = trained
+    assert trainer.publishes == 3 and trainer.publish_failures == 0
+    assert trainer.last_eval_loss is not None
+    versions = checkpoint.candidate_versions(ckpt_dir)
+    assert versions and versions[0] == max(versions)
+    v, updates, extra = checkpoint.load_staged(
+        ckpt_dir, trainer.main)
+    assert v == versions[0] and extra["step"] == 15
+    names = {n for n, _a in updates}
+    assert "fm_table" in names
+
+
+def test_checkpoint_publish_fault_survivable(trained):
+    trainer = trained[0]
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "checkpoint.publish:error@1")):
+        before = trainer.publish_failures
+        assert trainer.publish() is None
+    assert trainer.publish_failures == before + 1
+    assert flight.RECORDER.events(kind="publish.fail")
+
+
+def test_engine_reload_hot_swaps_zero_drop(trained):
+    trainer, _data, ckpt_dir = trained
+    eng = serving.ServingEngine(trainer.serve_dir, num_replicas=2,
+                                max_batch_size=4)
+    feed = {"feat_ids": np.zeros((1, 4), "int64"),
+            "dense_value": np.zeros((1, 4), "f4")}
+    before = float(eng.predict(feed, timeout_s=30.0)[0][0, 0])
+    errors, stop = [], threading.Event()
+
+    def driver():
+        while not stop.is_set():
+            try:
+                out, = eng.predict(feed, timeout_s=30.0)
+                assert np.isfinite(out).all()
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(e)
+
+    threads = [threading.Thread(target=driver) for _ in range(3)]
+    for t in threads:
+        t.start()
+    flight.RECORDER.clear()
+    versions = sorted(checkpoint.candidate_versions(ckpt_dir))
+    for v in versions:  # swap while requests are in flight
+        assert eng.reload(ckpt_dir, version=v) == v
+    stop.set()
+    for t in threads:
+        t.join()
+    after = float(eng.predict(feed, timeout_s=30.0)[0][0, 0])
+    eng.shutdown()
+    assert not errors  # zero drops: every in-flight request completed
+    assert eng.swap_count == len(versions)
+    assert eng.serve_version == versions[-1]
+    assert after != before  # the weights actually changed
+    swaps = flight.RECORDER.events(kind="model.swap")
+    assert len(swaps) == len(versions)
+    assert swaps[-1]["version"] == versions[-1]
+
+
+def test_publisher_corrupt_version_falls_back(trained):
+    trainer, _data, ckpt_dir = trained
+    eng = serving.ServingEngine(trainer.serve_dir, num_replicas=1,
+                                max_batch_size=4)
+    pub = streaming.ModelPublisher(ckpt_dir, eng, poll_interval_s=0.01)
+    first = pub.poll_once()
+    assert first == checkpoint.candidate_versions(ckpt_dir)[0]
+    assert pub.version_lag() == 0
+    # a fresh publish lands corrupt: fallback keeps serving, lag shows
+    w = checkpoint.save_checkpoint(
+        None, ckpt_dir, main_program=trainer.main, scope=trainer.scope,
+        max_versions=5)
+    w.wait()
+    newest = checkpoint.candidate_versions(ckpt_dir)[0]
+    checkpoint._flip_byte(os.path.join(
+        ckpt_dir, "checkpoint_%d" % newest, "replicated.npz"))
+    flight.RECORDER.clear()
+    with pytest.warns(RuntimeWarning, match="falling back"):
+        assert pub.poll_once() is None
+    assert pub.served_version == first and pub.bad_publishes == 1
+    assert pub.version_lag() >= 1  # the staleness gauge reflects the lag
+    assert pub._staleness_s >= 0.0
+    bad = flight.RECORDER.events(kind="publish.bad_version")
+    assert bad and bad[0]["version"] == newest
+    text = pub.registry.prometheus_text()
+    assert "paddle_tpu_stream_serve_version_lag" in text
+    eng.shutdown()
+    pub.stop()
+
+
+def test_publisher_breaker_opens_on_repeated_bad_publishes(trained,
+                                                           tmp_path):
+    from paddle_tpu.reliability.policy import CircuitBreaker
+
+    trainer = trained[0]
+    ckpt_dir = str(tmp_path / "bad-ckpts")
+    for _ in range(2):  # two publishes, both land corrupt
+        w = checkpoint.save_checkpoint(
+            None, ckpt_dir, main_program=trainer.main,
+            scope=trainer.scope)
+        w.wait()
+        checkpoint._flip_byte(os.path.join(w.path, "replicated.npz"))
+
+    class _NeverTarget:
+        def reload(self, _d, version=None):
+            raise AssertionError("breaker must gate this")
+
+    eng = _NeverTarget()
+    pub = streaming.ModelPublisher(
+        ckpt_dir, eng, breaker=CircuitBreaker(failure_threshold=2,
+                                              reset_timeout_s=3600.0))
+
+    class _FailTarget:
+        def reload(self, _d, version=None):
+            raise IOError("CRC mismatch")
+
+    pub.target = _FailTarget()
+    with pytest.warns(RuntimeWarning):
+        assert pub.poll_once() is None  # both versions fail -> OPEN
+    assert pub.breaker.state == pub.breaker.OPEN
+    assert pub.bad_publishes == 2
+    pub.target = eng
+    assert pub.poll_once() is None  # gated: target never touched
+
+
+def test_router_fleet_reload_verb(tmp_path):
+    """The multi-process swap plane: ``reload`` broadcasts through the
+    router to every worker, which stages + swaps its own engine."""
+    from paddle_tpu.serving.router import Router, RouterClient
+    from paddle_tpu.serving.worker import build_model
+
+    # a checkpoint matching builtin:fc (deterministic names: seed 11 +
+    # unique_name.switch), with deliberately scaled weights
+    pred = build_model("builtin:fc")
+    scope, prog = pred._scope, pred._program
+    for name in scope.var_names():
+        if ".w_" in name:
+            scope.set(name, np.asarray(scope.get(name)) * 3.0)
+    ckpt_dir = str(tmp_path / "ckpt")
+    checkpoint.save_checkpoint(None, ckpt_dir, main_program=prog,
+                               scope=scope, async_write=False)
+
+    router = Router("builtin:fc", num_workers=2, spawn_timeout_s=90.0)
+    with router:
+        client = RouterClient(router.address, default_timeout_s=60.0)
+        feed = {"x": np.ones((1, 8), "f4")}
+        before = client.predict(feed)[0]
+        got = client.reload(ckpt_dir)
+        assert got["version"] == 0
+        assert sorted(r["index"] for r in got["workers"]) == [0, 1]
+        assert all("version" in r for r in got["workers"])
+        after = client.predict(feed)[0]
+        assert not np.allclose(before, after)
+        # a bad dir is typed, not fatal: the fleet keeps serving
+        with pytest.raises(serving.WorkerFailedError):
+            client.reload(str(tmp_path / "nope"))
+        assert np.allclose(client.predict(feed)[0], after)
+        client.close()
+
+
+# -- the soak: accuracy improves across live hot-swaps ----------------------
+
+def test_fast_soak_fake_clock_hot_swap_improves(tmp_path):
+    """Tier-1 fake-clock soak: trainer + 2-replica engine. The accuracy
+    proxy (held-out loss) improves across >= 3 hot swaps, serving p99
+    holds, zero in-flight drops — surviving one injected trainer crash
+    mid-publish and one corrupt published version (fallback + staleness
+    lag). The slow full-router variant is below."""
+    data_dir, ckpt_dir = str(tmp_path / "data"), str(tmp_path / "ckpt")
+    streaming.synthesize_stream_files(data_dir, n_files=2,
+                                      rows_per_file=500, seed=5)
+    trainer = streaming.StreamingTrainer(
+        ckpt_dir, batch_size=16, publish_every_steps=8, max_versions=4,
+        hidden_sizes=(16,), holdout_batches=2, learning_rate=0.05)
+    eng = serving.ServingEngine(trainer.serve_dir, num_replicas=2,
+                                max_batch_size=4)
+    pub = streaming.ModelPublisher(ckpt_dir, eng, poll_interval_s=0.01)
+
+    feed = {"feat_ids": np.zeros((1, 4), "int64"),
+            "dense_value": np.full((1, 4), 0.5, "f4")}
+    eng.predict(feed, timeout_s=60.0)  # pre-compile before timing
+    latencies, errors, stop = [], [], threading.Event()
+
+    def driver():
+        while not stop.is_set():
+            t0 = time.monotonic()
+            try:
+                out, = eng.predict(feed, timeout_s=30.0)
+                assert np.isfinite(out).all()
+                latencies.append(time.monotonic() - t0)
+            except Exception as e:  # pragma: no cover - the assertion
+                errors.append(e)
+
+    eval_curve, lag_seen = [], []
+
+    def on_publish(tr):
+        eval_curve.append(tr.last_eval_loss)
+        pub.poll_once()
+        lag_seen.append(pub.version_lag())
+
+    driver_t = threading.Thread(target=driver)
+    driver_t.start()
+    flight.RECORDER.clear()
+    plan = faults.FaultPlan.from_spec(
+        "checkpoint.publish:error@2;checkpoint.publish:corrupt@4")
+    try:
+        with faults.fault_scope(plan):
+            with pytest.warns(RuntimeWarning, match="falling back"):
+                trainer.run(_drained(data_dir), max_steps=48,
+                            on_publish=on_publish)
+    finally:
+        stop.set()
+        driver_t.join()
+        trainer.close()
+        eng.shutdown()
+        pub.stop()
+
+    # >= 3 live swaps, predictions kept flowing with zero drops
+    assert pub.swap_count >= 3 and eng.swap_count >= 3
+    assert not errors and latencies
+    # accuracy proxy improved across the swaps
+    assert len(eval_curve) >= 4
+    assert eval_curve[-1] < eval_curve[0]
+    # survived exactly one injected mid-publish crash + one corrupt
+    # version; the corrupt one left the fleet visibly lagging
+    assert trainer.publish_failures == 1
+    assert pub.bad_publishes >= 1
+    assert max(lag_seen) >= 1  # staleness gauge reflected the lag
+    assert flight.RECORDER.events(kind="publish.bad_version")
+    assert len(flight.RECORDER.events(kind="model.swap")) >= 3
+    # serving p99 held while swapping (generous CPU bound: the point is
+    # "no multi-second stall from a swap", not absolute latency)
+    p99 = sorted(latencies)[max(0, int(0.99 * len(latencies)) - 1)]
+    assert p99 < 10.0, "p99 %.3fs during hot swaps" % p99
+
+
+@pytest.mark.slow
+def test_soak_router_two_workers_hot_swap(tmp_path):
+    """The full ISSUE-18 acceptance loop: trainer + 2-WORKER ROUTER,
+    publisher broadcasting ``reload`` over RPC, accuracy improving
+    across >= 3 swaps with zero drops, surviving a mid-publish crash and
+    a corrupt version."""
+    from paddle_tpu.serving.router import Router, RouterClient
+
+    data_dir, ckpt_dir = str(tmp_path / "data"), str(tmp_path / "ckpt")
+    streaming.synthesize_stream_files(data_dir, n_files=2,
+                                      rows_per_file=500, seed=5)
+    trainer = streaming.StreamingTrainer(
+        ckpt_dir, batch_size=16, publish_every_steps=8, max_versions=4,
+        hidden_sizes=(16,), holdout_batches=2, learning_rate=0.05)
+    router = Router(trainer.serve_dir, num_workers=2,
+                    spawn_timeout_s=120.0)
+    with router:
+        client = RouterClient(router.address, default_timeout_s=60.0)
+        pub = streaming.ModelPublisher(
+            ckpt_dir, streaming.RouterTarget(client),
+            poll_interval_s=0.01)
+        feed = {"feat_ids": np.zeros((1, 4), "int64"),
+                "dense_value": np.full((1, 4), 0.5, "f4")}
+        client.predict(feed)  # pre-compile both workers' engines
+        latencies, errors, stop = [], [], threading.Event()
+
+        def driver():
+            while not stop.is_set():
+                t0 = time.monotonic()
+                try:
+                    client.predict(feed)
+                    latencies.append(time.monotonic() - t0)
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+
+        eval_curve, lag_seen = [], []
+
+        def on_publish(tr):
+            eval_curve.append(tr.last_eval_loss)
+            pub.poll_once()
+            lag_seen.append(pub.version_lag())
+
+        driver_t = threading.Thread(target=driver)
+        driver_t.start()
+        plan = faults.FaultPlan.from_spec(
+            "checkpoint.publish:error@2;checkpoint.publish:corrupt@4")
+        try:
+            with faults.fault_scope(plan), \
+                    pytest.warns(RuntimeWarning, match="falling back"):
+                trainer.run(_drained(data_dir), max_steps=48,
+                            on_publish=on_publish)
+        finally:
+            stop.set()
+            driver_t.join()
+            trainer.close()
+            pub.stop()
+        assert pub.swap_count >= 3
+        assert not errors and latencies
+        assert eval_curve[-1] < eval_curve[0]
+        assert trainer.publish_failures == 1
+        assert pub.bad_publishes >= 1 and max(lag_seen) >= 1
+        p99 = sorted(latencies)[max(0, int(0.99 * len(latencies)) - 1)]
+        assert p99 < 10.0
+        client.close()

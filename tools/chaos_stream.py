@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Chaos drill for the streaming train-to-serve loop (ISSUE 18).
+
+Runs the continuous-learning pipeline against its two nastiest
+failures and audits that serving never noticed:
+
+  * **trainer SIGKILL mid-publish** (full drill) — a trainer subprocess
+    (``python -m paddle_tpu.streaming.trainer``) is killed while a
+    checkpoint version is half-written (a ``checkpoint.write:hang``
+    fault widens the window). The torn, manifest-less version dir must
+    be invisible to ``checkpoint.candidate_versions``, and a restarted
+    trainer must publish fresh versions right past it.
+  * **corrupt newest version** (both modes) — the newest publish is
+    byte-flipped on disk; the ModelPublisher must fall back to the
+    previous intact version (counted in ``bad_publishes``, recorded as
+    a ``publish.bad_version`` flight event) while a concurrent client
+    sees zero failed requests.
+
+After the drill the **flight dump** is audited: the parent's ring must
+hold the ``model.swap`` + ``publish.bad_version`` evidence, and (full
+drill) the restarted trainer's own dump must account for every publish
+it claimed. A missing event fails the drill like a silent loss would.
+
+    python tools/chaos_stream.py             # full: kill + corrupt
+    python tools/chaos_stream.py --smoke     # lint.sh gate: in-process
+                                             # corrupt-version drill
+
+Prints one JSON summary line (counters + verdict); exit 0 = ok.
+"""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _wait_torn_window(proc, ckpt_dir, version, manifest_name, timeout_s):
+    """Until ``checkpoint_<version>`` exists WITHOUT its manifest — the
+    mid-publish window a kill must land in. False if the trainer exits
+    or the window never opens."""
+    vdir = os.path.join(ckpt_dir, "checkpoint_%d" % version)
+    manifest = os.path.join(vdir, manifest_name)
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            return False
+        if os.path.isdir(vdir) and not os.path.exists(manifest):
+            return True
+        time.sleep(0.005)
+    return False
+
+
+def _spawn_trainer(data_dir, ckpt_dir, steps, publish_every, env_extra):
+    env = dict(os.environ)
+    env.update(env_extra)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "paddle_tpu.streaming.trainer",
+         "--data-dir", data_dir, "--ckpt-dir", ckpt_dir,
+         "--steps", str(steps), "--publish-every", str(publish_every),
+         "--poll-interval", "0.01"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True, env=env)
+    from paddle_tpu.streaming.trainer import TRAINER_READY_PREFIX
+
+    ready = None
+    for line in proc.stdout:
+        if line.startswith(TRAINER_READY_PREFIX):
+            ready = json.loads(line[len(TRAINER_READY_PREFIX):])
+            break
+    if ready is None:
+        proc.kill()
+        raise RuntimeError("trainer subprocess died before READY")
+    return proc, ready
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="chaos_stream", description=__doc__.splitlines()[0])
+    ap.add_argument("--rows", type=int, default=900)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--publish-every", type=int, default=5)
+    ap.add_argument("--requests", type=int, default=16,
+                    help="serving requests driven across the swap")
+    ap.add_argument("--timeout-s", type=float, default=90.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI preset: in-process trainer, corrupt-version "
+                         "drill only (no subprocess kill)")
+    args = ap.parse_args(argv)
+
+    import numpy as np
+
+    from paddle_tpu import checkpoint, serving, streaming
+    from paddle_tpu.obs import flight
+
+    flight_dir = tempfile.mkdtemp(prefix="paddle-tpu-flight-")
+    os.environ[flight.ENV_FLIGHT_DIR] = flight_dir
+
+    root = tempfile.mkdtemp(prefix="chaos-stream-")
+    data_dir = os.path.join(root, "data")
+    ckpt_dir = os.path.join(root, "ckpt")
+    streaming.synthesize_stream_files(
+        data_dir, n_files=2, rows_per_file=args.rows // 2, seed=5)
+
+    summary = {"mode": "smoke" if args.smoke else "full",
+               "kill": not args.smoke, "killed_mid_publish": None,
+               "torn_versions": None, "restart_publishes": None,
+               "candidates": None, "served_version": None,
+               "swap_count": 0, "bad_publishes": 0,
+               "requests_ok": 0, "request_errors": 0, "flight": None}
+
+    if args.smoke:
+        trainer = streaming.StreamingTrainer(
+            ckpt_dir, batch_size=16, publish_every_steps=args.publish_every,
+            max_versions=4, hidden_sizes=(16,), holdout_batches=2)
+        stream = streaming.RecordStream(data_dir, poll_interval_s=0.0,
+                                        sleep=lambda _t: None)
+        stream.close()
+        trainer.run(stream, max_steps=args.steps)
+        trainer.close()
+        serve_dir = trainer.serve_dir
+    else:
+        # phase 1: kill a trainer subprocess mid-publish. The hang fault
+        # on its 2nd checkpoint.write holds the npz write open for
+        # seconds — the version dir exists, the manifest does not.
+        proc, _ready = _spawn_trainer(
+            data_dir, ckpt_dir, args.steps, args.publish_every,
+            {"PADDLE_TPU_FAULTS": "checkpoint.write:hang(3.0)@2"})
+        in_window = _wait_torn_window(proc, ckpt_dir, 1,
+                                      checkpoint._MANIFEST, args.timeout_s)
+        if in_window:
+            os.kill(proc.pid, signal.SIGKILL)
+        proc.wait()
+        summary["killed_mid_publish"] = in_window
+        # the torn dir must be invisible to the swap plane
+        dirs = sorted(int(d.split("_")[1]) for d in os.listdir(ckpt_dir)
+                      if d.startswith("checkpoint_")
+                      and d.split("_")[1].isdigit())
+        cands = checkpoint.candidate_versions(ckpt_dir)
+        summary["torn_versions"] = len(dirs) - len(cands)
+        # phase 2: a restarted trainer publishes right past the wreck
+        proc, _ready = _spawn_trainer(
+            data_dir, ckpt_dir, args.steps, args.publish_every, {})
+        out, _ = proc.communicate(timeout=args.timeout_s)
+        stats = json.loads(out.strip().splitlines()[-1])
+        summary["restart_publishes"] = stats["publishes"]
+        summary["trainer_pid"] = proc.pid
+        serve_dir = os.path.join(ckpt_dir, "serve")
+
+    # phase 3 (both modes): corrupt the newest version on disk, then
+    # hot-swap a live engine under client load — the publisher must fall
+    # back to the previous intact version, dropping nothing.
+    versions = checkpoint.candidate_versions(ckpt_dir)
+    newest = versions[0]
+    checkpoint._flip_byte(os.path.join(
+        ckpt_dir, "checkpoint_%d" % newest, "replicated.npz"))
+    flight.RECORDER.clear()
+    eng = serving.ServingEngine(serve_dir, num_replicas=1,
+                                max_batch_size=4)
+    pub = streaming.ModelPublisher(ckpt_dir, eng, poll_interval_s=0.01)
+    feed = {"feat_ids": np.zeros((1, 4), "int64"),
+            "dense_value": np.full((1, 4), 0.5, "f4")}
+    import warnings
+    try:
+        eng.predict(feed, timeout_s=args.timeout_s)  # compile
+        for i in range(args.requests):
+            if i == args.requests // 2:
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore", RuntimeWarning)
+                    pub.poll_once()  # fallback swap, mid-burst
+            try:
+                eng.predict(feed, timeout_s=args.timeout_s)
+                summary["requests_ok"] += 1
+            except Exception:  # noqa: BLE001 — the count IS the verdict
+                summary["request_errors"] += 1
+        summary["candidates"] = versions
+        summary["served_version"] = pub.served_version
+        summary["swap_count"] = pub.swap_count
+        summary["bad_publishes"] = pub.bad_publishes
+    finally:
+        pub.stop()
+        eng.shutdown(drain=True)
+
+    summary["flight"] = _audit_flight(flight, flight_dir, summary,
+                                      newest=newest)
+    ok = (summary["request_errors"] == 0
+          and summary["requests_ok"] == args.requests
+          and summary["swap_count"] >= 1
+          and summary["bad_publishes"] >= 1
+          and summary["served_version"] is not None
+          and summary["served_version"] != newest
+          and summary["flight"]["audit"] == "ok"
+          and (args.smoke or (summary["killed_mid_publish"]
+                              and summary["torn_versions"] >= 1
+                              and summary["restart_publishes"] >= 1)))
+    summary["verdict"] = "ok" if ok else "FAIL"
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+def _audit_flight(flight, flight_dir, summary, newest):
+    """The drill's decisions must be reconstructible from the dump: the
+    corrupt version shows up as ``publish.bad_version`` naming exactly
+    the flipped version, the fallback as a ``model.swap``; on the full
+    drill, the restarted trainer's own dump must account for every
+    publish it claimed."""
+    path = flight.maybe_dump(reason="chaos-stream")
+    try:
+        dump = flight.load(path)
+    except (OSError, ValueError, TypeError) as e:
+        return {"audit": "FAIL", "error": "no dump at %r: %r" % (path, e)}
+    bad = [e for e in dump["events"] if e["kind"] == "publish.bad_version"]
+    swaps = [e for e in dump["events"] if e["kind"] == "model.swap"]
+    ok = (len(bad) >= 1 and all(e["version"] == newest for e in bad)
+          and len(swaps) >= 1)
+    trainer_publishes = None
+    if summary.get("trainer_pid") is not None:
+        tp = os.path.join(flight_dir,
+                          "flight-%d.json" % summary["trainer_pid"])
+        try:
+            tdump = flight.load(tp)
+            trainer_publishes = sum(
+                1 for e in tdump["events"]
+                if e["kind"] == "publish.version")
+            ok = ok and trainer_publishes == summary["restart_publishes"]
+        except (OSError, ValueError) as e:
+            return {"audit": "FAIL",
+                    "error": "no trainer dump at %r: %r" % (tp, e)}
+    return {"audit": "ok" if ok else "FAIL", "dir": flight_dir,
+            "bad_version_events": len(bad), "swap_events": len(swaps),
+            "trainer_publish_events": trainer_publishes,
+            "counts": dump.get("counts", {})}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,6 +19,11 @@
 #      a deterministic fake-clock capture through the summarizer —
 #      critical path + cross-process stitch check must agree with the
 #      obs/trace span format.
+#   5. the streaming chaos smoke (`tools/chaos_stream.py --smoke`, ISSUE
+#      18): an in-process train-to-serve loop, the newest published
+#      version corrupted on disk — the publisher must fall back to the
+#      previous intact version mid-burst with zero failed requests and
+#      a flight dump that proves it.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -39,5 +44,7 @@ JAX_PLATFORMS=cpu "$PY" -m paddle_tpu.analysis --zoo -q
 JAX_PLATFORMS=cpu "$PY" tools/chaos_router.py --smoke
 
 JAX_PLATFORMS=cpu "$PY" tools/trace_view.py --smoke
+
+JAX_PLATFORMS=cpu "$PY" tools/chaos_stream.py --smoke
 
 echo "lint.sh: ok"
