@@ -837,6 +837,10 @@ def _bench_streaming(on_tpu):
     p99 measured over requests IN FLIGHT DURING a swap — the zero-drop
     hot-swap claim in numbers. ``vs_baseline`` is the p99 budget over
     that during-swap p99 (>= 1.0 = swaps are latency-invisible).
+    Since ISSUE 19 the record also carries the ``fleet`` block:
+    partition-lease takeover latency after a host death, the wall cost
+    of a fleet-wide two-phase (prepare/commit) swap across 2 targets,
+    and the counted row replay of an exactly-once cursor resume.
 
     Knobs: BENCH_STREAMING_ROWS, BENCH_STREAMING_BATCH,
     BENCH_STREAMING_PUBLISH_EVERY, BENCH_STREAMING_REPLICAS."""
@@ -918,9 +922,52 @@ def _bench_streaming(on_tpu):
         publish_failures = trainer.publish_failures
         bad_chunks = stream.bad_chunks
         pub.stop()
+
+        # -- fleet drills (ISSUE 19): the multi-host figures ---------------
+        # 1. lease takeover latency: a dead host's partitions must be
+        #    reclaimed in ~TTL + one poll, not minutes
+        lease_ttl_s = 0.05
+        host_a = streaming.PartitionCoordinator(
+            root, "bench-a", num_partitions=2, ttl_s=lease_ttl_s)
+        host_a.poll()
+        t_death = time.perf_counter()  # host-a never renews again
+        host_b = streaming.PartitionCoordinator(
+            root, "bench-b", num_partitions=2, ttl_s=lease_ttl_s)
+        while len(host_b.owned) < 2:
+            host_b.poll()
+            time.sleep(0.002)
+        reassign_takeover_s = time.perf_counter() - t_death
+        partitions_reassigned = host_b.reassigned
+        host_b.release_all()
+        # 2. two-phase commit convergence: wall time for a cold fleet of
+        #    2 targets to prepare+commit the newest published version
+        eng2 = serving.ServingEngine(trainer.serve_dir, num_replicas=1,
+                                     max_batch_size=8)
+        fp = streaming.FleetPublisher(ckpt_dir, {"a": eng, "b": eng2})
+        t0 = time.perf_counter()
+        fleet_version = fp.poll_once()
+        commit_convergence_s = time.perf_counter() - t0
+        fleet_skew = fp.version_skew()
+        fp.release()
+        # 3. exactly-once resume: kill a consumer mid-file, seek a fresh
+        #    stream from its durable cursor, count the bounded replay
+        sc = streaming.RecordStream(data_dir, poll_interval_s=0.0,
+                                    sleep=lambda _t: None)
+        sc.close()
+        it = sc.records()
+        delivered = sum(1 for _ in zip(it, range(ingested // 2)))
+        cur = sc.cursor()
+        sr = streaming.RecordStream(data_dir, poll_interval_s=0.0,
+                                    sleep=lambda _t: None)
+        sr.close()
+        sr.seek(cur)
+        resumed = sum(1 for _ in sr.records())
+        resume_replayed_rows = max(0, delivered + resumed - ingested)
     finally:
         if "eng" in locals():
             eng.shutdown(drain=True)
+        if "eng2" in locals():
+            eng2.shutdown(drain=True)
         shutil.rmtree(root, ignore_errors=True)
 
     def p(samples, q):
@@ -952,6 +999,18 @@ def _bench_streaming(on_tpu):
         "serving_p99_s": p(all_lat, 99),
         "serving_p99_during_swap_s": p99_during,
         "during_swap_requests": len(during),
+        # the multi-host loop's own figures (ISSUE 19): how fast a dead
+        # host's partitions come back, what a fleet-wide two-phase swap
+        # costs, and how many rows an exactly-once resume re-reads
+        "fleet": {
+            "lease_ttl_s": lease_ttl_s,
+            "reassign_takeover_s": round(reassign_takeover_s, 6),
+            "partitions_reassigned": partitions_reassigned,
+            "fleet_targets": 2,
+            "fleet_version": fleet_version,
+            "commit_convergence_s": round(commit_convergence_s, 6),
+            "fleet_version_skew": fleet_skew,
+            "resume_replayed_rows": resume_replayed_rows},
         "accuracy_proxy": {
             "eval_loss_first": eval_curve[0] if eval_curve else None,
             "eval_loss_last": eval_curve[-1] if eval_curve else None,

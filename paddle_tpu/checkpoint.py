@@ -70,6 +70,18 @@ class CheckpointWriter:
             raise self.error
         return self.path
 
+    def wait_until(self, deadline):
+        """Bounded join against a ``reliability.policy.Deadline`` — the
+        preemption grace path: a write that cannot land inside the grace
+        budget is abandoned to the OS (False), never blocked on. Write
+        errors are reported, not raised (the caller is already dying)."""
+        if self._thread is not None:
+            self._thread.join(max(0.0, deadline.remaining()))
+            if self._thread.is_alive():
+                return False
+            self._thread = None
+        return self.error is None
+
     def done(self):
         return self._thread is None or not self._thread.is_alive()
 
@@ -640,6 +652,26 @@ def candidate_versions(checkpoint_dir):
     if not os.path.isdir(checkpoint_dir):
         return []
     return _candidate_versions(checkpoint_dir)
+
+
+def load_extra(checkpoint_dir, version=None):
+    """Read just the ``extra`` metadata of one version — no array loads,
+    no scope. With ``version=None``, walks ``candidate_versions`` newest
+    first past torn manifests. Returns ``(version, extra)``, or
+    ``(None, {})`` when nothing intact exists. The streaming plane uses
+    this to recover ingest cursors from a (possibly dead) peer host's
+    publish dir without paying for its weights."""
+    versions = ([int(version)] if version is not None
+                else candidate_versions(checkpoint_dir))
+    for v in versions:
+        try:
+            with open(os.path.join(checkpoint_dir, "checkpoint_%d" % v,
+                                   _MANIFEST)) as f:
+                manifest = json.load(f)
+            return int(v), manifest.get("extra", {})
+        except (OSError, ValueError):
+            continue
+    return None, {}
 
 
 def load_staged(checkpoint_dir, main_program, version=None):

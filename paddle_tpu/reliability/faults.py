@@ -35,6 +35,19 @@ the first record the poll delivers — a torn tail read) and
 models a publish dying mid-flight — counted, training continues —
 ``corrupt`` lands a damaged version so the swap plane's
 fallback-to-previous-intact path and its circuit breaker engage).
+Fleet-coordinated streaming adds four more: ``lease.renew`` (tripped
+per partition-lease renewal in the coordinator; ``error`` models a
+missed heartbeat — enough of them and survivors reclaim the
+partition), ``cursor.write`` (tripped when the trainer captures its
+ingest cursor for a publish; ``error`` fails that publish whole —
+a version must never land without its cursor — ``corrupt`` zeroes the
+offsets, forcing a full but *counted* replay on resume), and the
+two-phase swap pair ``swap.prepare`` / ``swap.commit`` (tripped in the
+serving engine per phase; a prepare ``error`` aborts the whole fleet
+round — nothing swaps — while a commit ``error`` after successful
+prepares exercises the retry-then-quarantine path and the
+``fleet_version_skew`` gauge; ``swap.prepare:corrupt`` models a
+staged-bytes CRC mismatch).
 
 Multi-process note: the env grammar is how faults cross a process
 boundary — the router passes ``worker_env={"PADDLE_TPU_FAULTS":

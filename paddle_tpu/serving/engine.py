@@ -157,6 +157,8 @@ class ServingEngine:
         # them after the per-replica scope flip
         self.serve_version = None
         self.swap_count = 0
+        self._staged_swap = None  # (version, updates) held between
+        # prepare and commit of a two-phase fleet swap
         self.max_replica_failures = max_replica_failures or 0
         self.cross_replica_retry = bool(cross_replica_retry)
         self.shed_on_overload = bool(shed_on_overload)
@@ -701,6 +703,81 @@ class ServingEngine:
         flight.record("model.swap", version=version, applied=applied,
                       replicas=len(self._workers), swap=self.swap_count)
         return version
+
+    def prepare(self, source, version=None):
+        """Phase 1 of the two-phase fleet swap: CRC-stage a version
+        WITHOUT touching the served weights. The staged update list is
+        held until :meth:`commit` applies it or :meth:`abort_swap` drops
+        it; re-preparing replaces the staged version. Serving continues
+        on the old weights throughout — an aborted prepare leaves no
+        trace. Fault site ``swap.prepare``: ``error``/``corrupt`` fail
+        the stage (the fleet publisher must then abort everywhere).
+        Returns the staged version."""
+        mode = faults.trip("swap.prepare")
+        if self._decoders is not None:
+            raise NotImplementedError(
+                "prepare() is not supported in decode mode: KV caches "
+                "are conversation state entangled with the weights")
+        if self._closed:
+            raise RuntimeError("engine is shut down")
+        if isinstance(source, str):
+            from .. import checkpoint
+
+            prog = getattr(self._parent, "_program", None)
+            if prog is None:
+                raise TypeError(
+                    "prepare from a checkpoint dir needs a program-backed "
+                    "predictor; got %r" % (type(self._parent).__name__,))
+            version, updates, _extra = checkpoint.load_staged(
+                source, prog, version=version)
+        else:
+            updates = list(source)
+        if mode == "corrupt":
+            raise IOError("swap.prepare: staged bytes corrupt (injected)")
+        self._staged_swap = (version, updates)
+        flight.record("swap.prepare", version=version,
+                      staged=len(updates))
+        return version
+
+    def commit(self, version=None):
+        """Phase 2: atomically swap the prepared version in. Idempotent
+        under retry — committing a ``version`` that already serves
+        returns success, so a fleet publisher's RetryPolicy can re-drive
+        a commit whose ACK was lost. Raises when nothing (or a different
+        version) is staged. Fault site ``swap.commit`` drills the
+        partial-commit / quarantine path."""
+        faults.trip("swap.commit")
+        staged = self._staged_swap
+        if staged is None:
+            if version is not None and self.serve_version == version:
+                return version  # lost-ACK retry of a landed commit
+            raise RuntimeError(
+                "commit(%r): no staged version (prepare first)"
+                % (version,))
+        sv, updates = staged
+        if version is not None and sv != version:
+            raise RuntimeError(
+                "commit(%r): staged version is %r" % (version, sv))
+        sp = trace.span("model.swap")
+        with sp:
+            if sp:
+                sp.set(version=sv, replicas=len(self._workers))
+            applied = self._swap_scopes(updates)
+        self._staged_swap = None
+        self.serve_version = sv
+        self.swap_count += 1
+        flight.record("swap.commit", version=sv, applied=applied,
+                      replicas=len(self._workers), swap=self.swap_count)
+        return sv
+
+    def abort_swap(self):
+        """Drop a staged-but-uncommitted version (any target failing
+        prepare aborts the whole fleet — nothing swaps). Returns True
+        when something was staged."""
+        staged, self._staged_swap = self._staged_swap, None
+        if staged is not None:
+            flight.record("swap.abort", version=staged[0])
+        return staged is not None
 
     def _swap_scopes(self, updates):
         """Copy-and-overlay every distinct predictor scope, then flip the

@@ -703,6 +703,72 @@ class Router:
         return {"type": "reloaded", "version": version,
                 "workers": results}, None
 
+    def _broadcast_verb(self, verb, fwd, ok_type):
+        """Drive one swap verb across every live worker; per-worker
+        verdicts ride back. Returns (results, versions_that_succeeded)."""
+        with self._cv:
+            workers = list(self._workers)
+        results = []
+        for w in workers:
+            if not w.healthy:
+                results.append({"index": w.index, "error": "unhealthy"})
+                continue
+            try:
+                rh, _ = self._send_to_worker(w, dict(fwd), None, None)
+            except Exception as e:  # noqa: BLE001 — per-worker verdicts
+                results.append({"index": w.index,
+                                "error": "%s: %s" % (type(e).__name__, e)})
+                continue
+            if rh.get("type") == ok_type:
+                results.append({"index": w.index,
+                                "version": rh.get("version")})
+            else:
+                results.append({"index": w.index,
+                                "error": rh.get("message",
+                                                rh.get("error"))})
+        return results, [r["version"] for r in results if "version" in r]
+
+    def _handle_prepare(self, header):
+        """Phase 1, router-local all-or-nothing: EVERY worker must stage
+        the version or the router aborts its own workers and reports
+        typed failure — a router either joins the fleet's swap whole or
+        not at all (no intra-router mixed staging)."""
+        fwd = {"type": "prepare", "dir": header.get("dir"),
+               "version": header.get("version")}
+        results, prepared = self._broadcast_verb(
+            "prepare", fwd, "prepared")
+        if len(prepared) < len(results) or not prepared:
+            self._broadcast_verb("abort", {"type": "abort"}, "aborted")
+            flight.record("swap.prepare_failed", where="router",
+                          prepared=len(prepared), workers=len(results))
+            return {"type": "error", "error": "PrepareFailed",
+                    "message": "staged %d/%d workers: %s"
+                               % (len(prepared), len(results), results)}, \
+                None
+        flight.record("swap.prepare", where="router",
+                      version=min(prepared), workers=len(prepared))
+        return {"type": "prepared", "version": min(prepared),
+                "workers": results}, None
+
+    def _handle_commit(self, header):
+        """Phase 2: all live workers must flip (idempotent per worker,
+        so a retried commit converges). Partial worker commit is a typed
+        failure — the fleet publisher retries/quarantines the router."""
+        fwd = {"type": "commit", "version": header.get("version")}
+        results, committed = self._broadcast_verb(
+            "commit", fwd, "committed")
+        if len(committed) < len(results) or not committed:
+            flight.record("swap.commit_failed", where="router",
+                          committed=len(committed), workers=len(results))
+            return {"type": "error", "error": "CommitFailed",
+                    "message": "committed %d/%d workers: %s"
+                               % (len(committed), len(results),
+                                  results)}, None
+        flight.record("swap.commit", where="router",
+                      version=min(committed), workers=len(committed))
+        return {"type": "committed", "version": min(committed),
+                "workers": results}, None
+
     # -- front server -------------------------------------------------------
 
     def _make_server(self):
@@ -743,6 +809,14 @@ class Router:
                         }, None
                     elif kind == "reload":
                         resp, out = router._handle_reload(header)
+                    elif kind == "prepare":
+                        resp, out = router._handle_prepare(header)
+                    elif kind == "commit":
+                        resp, out = router._handle_commit(header)
+                    elif kind == "abort":
+                        router._broadcast_verb(
+                            "abort", {"type": "abort"}, "aborted")
+                        resp, out = {"type": "aborted"}, None
                     else:
                         resp, out = {"type": "error", "error": "Rpc",
                                      "message": "unknown message type %r"
@@ -842,6 +916,8 @@ class RouterClient:
         "WorkerFailed": WorkerFailedError,
         "RouterShutdown": RouterShutdownError,
         "ReloadFailed": WorkerFailedError,
+        "PrepareFailed": WorkerFailedError,
+        "CommitFailed": WorkerFailedError,
         "Rpc": rpc.RpcError,
     }
 
@@ -938,6 +1014,37 @@ class RouterClient:
             self._raise_typed(header)
         return {"version": header.get("version"),
                 "workers": header.get("workers", [])}
+
+    def prepare(self, ckpt_dir, version=None):
+        """Phase 1 of the fleet's two-phase swap: CRC-stage ``version``
+        on EVERY worker without swapping. All-or-nothing per router —
+        a partial stage aborts the router's workers and raises
+        :class:`WorkerFailedError` (kind ``PrepareFailed``)."""
+        header, _ = self._roundtrip(
+            {"type": "prepare", "dir": ckpt_dir, "version": version},
+            None)
+        if header.get("type") == "error":
+            self._raise_typed(header)
+        return {"version": header.get("version"),
+                "workers": header.get("workers", [])}
+
+    def commit(self, version=None):
+        """Phase 2: flip every worker to its staged version (idempotent
+        under retry). Raises :class:`WorkerFailedError` (kind
+        ``CommitFailed``) when any worker failed to flip."""
+        header, _ = self._roundtrip(
+            {"type": "commit", "version": version}, None)
+        if header.get("type") == "error":
+            self._raise_typed(header)
+        return {"version": header.get("version"),
+                "workers": header.get("workers", [])}
+
+    def abort(self):
+        """Drop any staged-but-uncommitted version on every worker."""
+        header, _ = self._roundtrip({"type": "abort"}, None)
+        if header.get("type") == "error":
+            self._raise_typed(header)
+        return True
 
     def prometheus(self):
         """Scrape the router's Prometheus exposition text (ping path)."""

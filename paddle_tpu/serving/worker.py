@@ -34,6 +34,15 @@ Protocol (one ``rpc`` frame in, one out, persistent connections):
     to the new parameters between micro-batches (in-flight requests
     finish on the old weights). A corrupt/mismatched version answers a
     typed ``{"error": "ReloadFailed"}`` and the old model keeps serving.
+  * ``{"type": "prepare", "dir": ..., "version": <n-or-null>}`` ->
+    ``{"type": "prepared", "version": N}`` — phase 1 of the fleet's
+    two-phase swap: CRC-stage the version WITHOUT swapping (typed
+    ``{"error": "PrepareFailed"}`` aborts the fleet's swap, nothing
+    flips anywhere).
+  * ``{"type": "commit", "version": <n-or-null>}`` ->
+    ``{"type": "committed", "version": N}`` — phase 2: flip to the
+    staged version; idempotent under retry (a lost ACK re-commits
+    clean). ``{"type": "abort"}`` drops a staged version.
   * ``{"type": "shutdown"}`` -> acked, then the process drains and exits.
 
 ``--model`` takes a ``save_inference_model`` directory or a
@@ -118,6 +127,10 @@ def _stats(state):
         "in_flight": eng._admission.in_flight,
         "deadline_refused": refused,
         "served": served,
+        # fleet version-skew must be auditable from OUTSIDE: every
+        # ping/stats answer names the model version this worker serves
+        "serve_version": eng.serve_version,
+        "swap_count": eng.swap_count,
     }
 
 
@@ -187,6 +200,35 @@ def _handle_reload(state, header):
             "swap_count": state.engine.swap_count}
 
 
+def _handle_prepare(state, header):
+    """Phase 1 of the fleet's two-phase swap: CRC-stage, don't touch the
+    served weights. Typed failure aborts the whole fleet's swap."""
+    ckpt_dir = header.get("dir")
+    if not ckpt_dir:
+        return {"type": "error", "error": "Rpc",
+                "message": "prepare needs a 'dir' field"}
+    try:
+        version = state.engine.prepare(ckpt_dir,
+                                       version=header.get("version"))
+    except Exception as e:
+        return {"type": "error", "error": "PrepareFailed",
+                "message": "%s: %s" % (type(e).__name__, e)}
+    return {"type": "prepared", "version": version}
+
+
+def _handle_commit(state, header):
+    """Phase 2: flip to the staged version (idempotent under retry).
+    Typed failure = this worker stays on the old weights; the fleet
+    publisher quarantines the target and makes the skew loud."""
+    try:
+        version = state.engine.commit(version=header.get("version"))
+    except Exception as e:
+        return {"type": "error", "error": "CommitFailed",
+                "message": "%s: %s" % (type(e).__name__, e)}
+    return {"type": "committed", "version": version,
+            "swap_count": state.engine.swap_count}
+
+
 def _make_server(host, port, state):
     class Handler(socketserver.BaseRequestHandler):
         def handle(self):
@@ -223,6 +265,13 @@ def _make_server(host, port, state):
                     }, None
                 elif kind == "reload":
                     resp, out = _handle_reload(state, header), None
+                elif kind == "prepare":
+                    resp, out = _handle_prepare(state, header), None
+                elif kind == "commit":
+                    resp, out = _handle_commit(state, header), None
+                elif kind == "abort":
+                    resp, out = {"type": "aborted",
+                                 "staged": state.engine.abort_swap()}, None
                 elif kind == "shutdown":
                     resp, out = {"type": "ok"}, None
                 else:

@@ -21,7 +21,18 @@ This package is that loop, TPU-native:
     and hot-swaps a live ``ServingEngine`` (or a whole router fleet via
     the ``reload`` RPC verb) between micro-batches — in-flight requests
     finish on the old weights, zero drops; corrupt versions fall back to
-    the previous intact one behind a circuit breaker.
+    the previous intact one behind a circuit breaker. Its fleet form,
+    :class:`FleetPublisher`, drives N targets through a two-phase swap
+    (prepare everywhere — any failure aborts the round — then commit
+    per-target on a retry policy; stragglers are quarantined loudly via
+    the ``fleet_version_skew`` gauge).
+  * :mod:`~paddle_tpu.streaming.coordinator` —
+    :class:`PartitionCoordinator`, multi-host ingest: stream-directory
+    partitions owned through TTL lease files (heartbeat-renewed,
+    crash-reclaimed), with exactly-once-resume cursors riding each
+    published checkpoint so a restarted or adopting host replays a
+    bounded, *counted* tail instead of losing or duplicating rows
+    silently.
 
 Quickstart (in-process; see README "Streaming training" for the
 multi-process router form)::
@@ -40,8 +51,11 @@ multi-process router form)::
 from .stream import (REGISTRY, RecordStream, StreamIngester,  # noqa: F401
                      TailReader, encode_chunk, write_records)
 from .trainer import StreamingTrainer, synthesize_stream_files  # noqa: F401
-from .publisher import ModelPublisher, RouterTarget  # noqa: F401
+from .publisher import (FleetPublisher, ModelPublisher,  # noqa: F401
+                        RouterTarget)
+from .coordinator import PartitionCoordinator, partition_of  # noqa: F401
 
 __all__ = ["RecordStream", "StreamIngester", "TailReader", "REGISTRY",
            "encode_chunk", "write_records", "StreamingTrainer",
-           "synthesize_stream_files", "ModelPublisher", "RouterTarget"]
+           "synthesize_stream_files", "ModelPublisher", "FleetPublisher",
+           "RouterTarget", "PartitionCoordinator", "partition_of"]

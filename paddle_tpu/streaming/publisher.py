@@ -19,6 +19,11 @@ delete the weights a serving process is using.
 Staleness: ``serve-version lag`` (how many publishes behind the fleet
 is) and ``staleness seconds`` (publish-to-swap latency, sampled per
 swap) export as gauges on the streaming registry.
+
+:class:`FleetPublisher` scales the same contract to N targets with a
+two-phase swap (prepare everywhere, then commit per-target on a retry
+policy) so a fleet either moves together or fails loudly — see its
+docstring for the quarantine / ``fleet_version_skew`` story.
 """
 
 import os
@@ -28,22 +33,32 @@ import warnings
 
 from .. import checkpoint
 from ..obs import flight
-from ..reliability.policy import CircuitBreaker
+from ..reliability.policy import CircuitBreaker, RetryError, RetryPolicy
 from .stream import REGISTRY
 
-__all__ = ["ModelPublisher", "RouterTarget"]
+__all__ = ["ModelPublisher", "FleetPublisher", "RouterTarget"]
 
 
 class RouterTarget:
     """Adapts a :class:`~paddle_tpu.serving.RouterClient` to the
-    publisher's target protocol (``reload(ckpt_dir, version=) -> int``):
-    the swap broadcasts to every worker in the fleet."""
+    publisher's target protocol (``reload(ckpt_dir, version=) -> int``
+    plus the two-phase ``prepare``/``commit``/``abort`` verbs): each
+    call broadcasts to every worker behind the router."""
 
     def __init__(self, client):
         self.client = client
 
     def reload(self, ckpt_dir, version=None):
         return self.client.reload(ckpt_dir, version=version)["version"]
+
+    def prepare(self, ckpt_dir, version=None):
+        return self.client.prepare(ckpt_dir, version=version)["version"]
+
+    def commit(self, version=None):
+        return self.client.commit(version=version)["version"]
+
+    def abort(self):
+        return self.client.abort()
 
 
 class ModelPublisher:
@@ -199,12 +214,27 @@ class ModelPublisher:
                               % (type(e).__name__, e), RuntimeWarning)
             self._sleep(self.poll_interval_s)
 
-    def stop(self, unpin=True):
+    def stop(self, unpin=False):
+        """Stop the watcher thread. The served version's pin is kept:
+        stopping the *publisher* does not stop the *serving process*,
+        and unpinning while replicas still serve those weights lets the
+        trainer's retention GC delete them out from under live traffic.
+        Call :meth:`release` once serving shutdown (or supersession by
+        a newer fleet version) is confirmed; ``unpin=True`` collapses
+        the two for callers that have already shut serving down."""
         self._stop.set()
         if self._thread is not None:
             self._thread.join(5.0)
             self._thread = None
-        if unpin and self.pin and self.served_version is not None:
+        if unpin:
+            self.release()
+
+    def release(self):
+        """Drop the retention pin on the served version. Only safe once
+        no replica serves those weights any more — after a confirmed
+        serving shutdown, or once the fleet converged on a newer
+        version and this one is superseded."""
+        if self.pin and self.served_version is not None:
             checkpoint.unpin_version(self.ckpt_dir, self.served_version,
                                      owner=self.pin_owner)
 
@@ -214,3 +244,207 @@ class ModelPublisher:
     def __exit__(self, *exc):
         self.stop()
         return False
+
+
+class FleetPublisher:
+    """Two-phase fleet-wide model swap across N serving targets.
+
+    Each target is anything with the two-phase verbs —
+    ``prepare(ckpt_dir, version=) -> version`` (CRC-stage the weights
+    without serving them), ``commit(version=) -> version`` (atomic
+    swap, idempotent on the already-served version), ``abort()`` — so
+    a :class:`~paddle_tpu.serving.ServingEngine` fits directly and a
+    :class:`RouterTarget` spans a whole router's worker pool.
+
+    The swap discipline:
+
+    * **prepare** runs on *every* healthy target first. Any single
+      failure aborts the round — every staged target gets ``abort()``,
+      nothing swaps, the failure feeds the :class:`CircuitBreaker`, and
+      the walk falls back to the next older intact version. A fleet
+      never half-stages.
+    * **commit** then runs per-target under a
+      :class:`~paddle_tpu.reliability.policy.RetryPolicy` (``commit``
+      is idempotent, so a lost ACK retries safely). A target that
+      exhausts its budget is **quarantined**: a
+      ``publish.partial_commit`` flight event fires, the
+      ``paddle_tpu_stream_fleet_version_skew`` gauge goes positive, and
+      the target is skipped until :meth:`readmit` — mixed fleets are
+      loud, never silent.
+
+    Retention: the fleet version is pinned; the previous pin is dropped
+    only once **no** target (quarantined ones included) still serves
+    it."""
+
+    def __init__(self, ckpt_dir, targets, breaker=None, retry=None,
+                 registry=None, clock=None, pin_owner=None, pin=True):
+        self.ckpt_dir = ckpt_dir
+        if isinstance(targets, dict):
+            self.targets = dict(targets)
+        else:
+            self.targets = {"target-%d" % i: t
+                            for i, t in enumerate(targets)}
+        if not self.targets:
+            raise ValueError("FleetPublisher needs at least one target")
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=3, reset_timeout_s=2.0, clock=clock,
+            name="fleet-publisher")
+        self.retry = retry or RetryPolicy(max_attempts=3,
+                                          base_delay_s=0.05)
+        self.pin = bool(pin)
+        self.pin_owner = pin_owner or ("fleet-%d" % os.getpid())
+        self.fleet_version = None           # version the fleet is on
+        self.target_versions = {}           # name -> served version
+        self.quarantined = set()            # names skipped until readmit
+        self.swap_rounds = 0
+        self.prepare_failures = 0
+        self.partial_commits = 0
+        self._pinned = set()
+        reg = registry if registry is not None else REGISTRY
+        self.registry = reg
+        self._c_partial = reg.counter(
+            "paddle_tpu_stream_partial_commits_total",
+            "fleet swap rounds that left at least one target behind")
+        reg.gauge("paddle_tpu_stream_fleet_version_skew",
+                  "targets not serving the fleet version (0 = converged)",
+                  fn=self.version_skew)
+        reg.gauge("paddle_tpu_stream_fleet_version",
+                  "checkpoint version the fleet last committed",
+                  fn=lambda: -1 if self.fleet_version is None
+                  else self.fleet_version)
+
+    # -- health --------------------------------------------------------------
+    def version_skew(self):
+        """Targets not serving :attr:`fleet_version` — quarantined or
+        never-committed targets count. 0 means the fleet converged."""
+        if self.fleet_version is None:
+            return 0
+        return sum(1 for name in self.targets
+                   if self.target_versions.get(name) != self.fleet_version)
+
+    def readmit(self, name):
+        """Lift a target's quarantine; the next :meth:`poll_once` tries
+        to converge it onto the fleet version again."""
+        self.quarantined.discard(name)
+
+    # -- the two-phase round -------------------------------------------------
+    def _active(self):
+        return [n for n in self.targets if n not in self.quarantined]
+
+    def _abort(self, name):
+        # engines expose ``abort_swap`` (``abort`` would be ambiguous on
+        # a serving surface); router targets expose ``abort``
+        t = self.targets[name]
+        fn = getattr(t, "abort_swap", None) or t.abort
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+    def _prepare_all(self, version, names):
+        staged = []
+        for name in names:
+            try:
+                self.targets[name].prepare(self.ckpt_dir, version=version)
+                staged.append(name)
+            except Exception as e:  # noqa: BLE001 — abort, stay up
+                self.prepare_failures += 1
+                for other in staged:
+                    self._abort(other)
+                tripped = self.breaker.record_failure()
+                flight.record("publish.prepare_failed", version=version,
+                              target=name, error=type(e).__name__,
+                              staged=len(staged), tripped=tripped)
+                warnings.warn(
+                    "fleet publisher: target %r failed prepare of version "
+                    "%d (%s: %s); round aborted, nothing swapped"
+                    % (name, version, type(e).__name__, e), RuntimeWarning)
+                return False
+        return True
+
+    def _commit_all(self, version, names):
+        committed = []
+        for name in names:
+            try:
+                self.retry.call(
+                    lambda t=self.targets[name]: t.commit(version=version))
+                self.target_versions[name] = version
+                committed.append(name)
+            except RetryError as e:
+                self.quarantined.add(name)
+                self.partial_commits += 1
+                self._c_partial.inc()
+                self._abort(name)
+                flight.record("publish.partial_commit", version=version,
+                              target=name, attempts=e.attempts,
+                              error=type(e.last).__name__,
+                              skew=self.version_skew() + 1)
+                warnings.warn(
+                    "fleet publisher: target %r exhausted %d commit "
+                    "attempt(s) for version %d (%r); QUARANTINED — fleet "
+                    "is version-skewed until it heals"
+                    % (name, e.attempts, version, e.last), RuntimeWarning)
+        return committed
+
+    def _repin(self, version):
+        if not self.pin:
+            return
+        if version not in self._pinned:
+            try:
+                checkpoint.pin_version(self.ckpt_dir, version,
+                                       owner=self.pin_owner)
+                self._pinned.add(version)
+            except FileNotFoundError:
+                pass  # GC raced the swap; the version is gone from disk
+        # drop pins no target serves any more — a quarantined target
+        # still serving an old version keeps that version's pin alive
+        live = set(self.target_versions.values())
+        for v in sorted(self._pinned - live - {version}):
+            checkpoint.unpin_version(self.ckpt_dir, v,
+                                     owner=self.pin_owner)
+            self._pinned.discard(v)
+
+    def poll_once(self):
+        """One detection + two-phase swap round. Returns the version the
+        fleet committed to, or None (nothing new / breaker open /
+        nothing intact / round aborted)."""
+        versions = checkpoint.candidate_versions(self.ckpt_dir)
+        if not versions:
+            return None
+        names = self._active()
+        if not names:
+            return None  # whole fleet quarantined: nothing to drive
+        stale = [n for n in names
+                 if self.target_versions.get(n) != versions[0]]
+        if not stale:
+            return None
+        if not self.breaker.allow():
+            return None
+        for v in versions:  # newest first; walk back past bad versions
+            if self.fleet_version is not None and v < self.fleet_version:
+                break  # never roll the fleet backwards
+            todo = [n for n in names if self.target_versions.get(n) != v]
+            if not todo:
+                break
+            if not self._prepare_all(v, todo):
+                continue  # this version is bad somewhere: try an older
+            committed = self._commit_all(v, todo)
+            if not committed:
+                return None
+            self.fleet_version = v
+            self.swap_rounds += 1
+            if v == versions[0] and len(committed) == len(todo):
+                self.breaker.record_success()
+            flight.record("publish.fleet_commit", version=v,
+                          committed=len(committed), skew=self.version_skew())
+            self._repin(v)
+            return v
+        return None
+
+    def release(self):
+        """Drop every retention pin this publisher holds. Only safe once
+        serving shutdown is confirmed fleet-wide."""
+        for v in sorted(self._pinned):
+            checkpoint.unpin_version(self.ckpt_dir, v,
+                                     owner=self.pin_owner)
+        self._pinned.clear()

@@ -92,9 +92,13 @@ class TailReader:
         self.records_read = 0
         self.bad_chunks = 0
         self.done = False
+        # (end_offset, n_records) per chunk parsed by the LAST poll —
+        # the provenance RecordStream's durable cursor advances on
+        self.last_chunks = []
 
     def poll(self, final=False):
         out = []
+        self.last_chunks = []
         try:
             size = os.path.getsize(self.path)
         except OSError:
@@ -141,6 +145,7 @@ class TailReader:
             out.extend(recs)
             self.records_read += len(recs)
             self.offset += _HEADER.size + plen
+            self.last_chunks.append((self.offset, len(recs)))
         return False
 
     def _rescan(self, f, size):
@@ -187,7 +192,14 @@ class RecordStream:
 
     ``rows_per_sec()`` is a sliding-window ingest rate, exported as the
     fn-backed gauge ``paddle_tpu_stream_ingest_rows_per_sec`` on
-    ``registry`` (default: the module :data:`REGISTRY`)."""
+    ``registry`` (default: the module :data:`REGISTRY`).
+
+    ``cursor()``/``seek()`` make the read position durable: a cursor is
+    a plain dict (per-file byte offsets + done flags + the cumulative
+    row count) that a trainer persists inside every published checkpoint
+    version, so a restarted host resumes the tail-follow exactly where
+    the last *published* state left off — at-least-once, with replay
+    bounded by the publish cadence."""
 
     def __init__(self, source, pattern="*.recordio", poll_interval_s=0.05,
                  registry=None, clock=None, sleep=None):
@@ -197,7 +209,18 @@ class RecordStream:
         self._clock = clock or time.monotonic
         self._sleep = sleep or time.sleep
         self._readers = {}
+        self._listed = []
+        self._seek = {}       # basename -> {"offset": int, "done": bool}
+        self._base_rows = 0   # rows accounted by an adopted cursor
+        self._delivered = 0   # records handed to the consumer
+        # the durable cursor: per-file positions at the last *delivered*
+        # chunk boundary — never ahead of what the consumer has seen, so
+        # resuming from it is at-least-once (replay <= one chunk + the
+        # polls in flight), never at-most-once
+        self._safe = {}
+        self._safe_rows = 0
         self._closed = threading.Event()
+        self._interrupted = threading.Event()
         self._window = deque(maxlen=64)  # (t, records_total) checkpoints
         reg = registry if registry is not None else REGISTRY
         self.registry = reg
@@ -216,6 +239,12 @@ class RecordStream:
         """No more appends will happen: drain what landed, then stop."""
         self._closed.set()
 
+    def interrupt(self):
+        """Stop iterating NOW, without draining — the preemption path.
+        The read position stays consistent: ``cursor()`` after an
+        interrupt is a valid resume point."""
+        self._interrupted.set()
+
     @property
     def closed(self):
         return self._closed.is_set()
@@ -229,12 +258,65 @@ class RecordStream:
     def bad_chunks(self):
         return sum(r.bad_chunks for r in self._readers.values())
 
+    @property
+    def rows_total(self):
+        """Rows DELIVERED to the consumer over the stream's whole life,
+        including rows the resumed cursor already accounted for (parsed-
+        but-undelivered rows are excluded — they will come again)."""
+        return self._base_rows + self._delivered
+
     def rows_per_sec(self):
         w = self._window
         if len(w) < 2:
             return 0.0
         dt = w[-1][0] - w[0][0]
         return (w[-1][1] - w[0][1]) / dt if dt > 0 else 0.0
+
+    # -- durable position ----------------------------------------------------
+    def cursor(self):
+        """The stream's durable resume point: per-file byte offsets (keyed
+        by basename, so checkpoint dirs stay relocatable), each file's
+        rotation state (``done`` = sealed and fully drained), and the row
+        count at that point. The position is the last chunk boundary
+        whose records were all DELIVERED — parsed-but-undelivered bytes
+        sit past it, so a resume re-reads them (at-least-once) instead of
+        skipping them (at-most-once). JSON-serializable; feed it to
+        :meth:`seek` on a fresh stream over the same directory."""
+        files = {n: dict(e) for n, e in self._safe.items()}
+        for name, ent in self._seek.items():  # adopted but not yet opened
+            files.setdefault(name, dict(ent))
+        return {"rows": self._base_rows + self._safe_rows, "files": files}
+
+    def seek(self, cursor, merge=False):
+        """Restore a :meth:`cursor`. Plain ``seek`` (before iteration
+        starts) positions every file and adopts the cursor's row count;
+        ``merge=True`` folds in positions for *additional* files mid-run
+        — the partition-handover path, where a survivor adopts a dead
+        host's published offsets instead of re-reading its partitions
+        from byte 0. Unknown files in the cursor are kept pending and
+        applied if/when they appear in the listing."""
+        files = cursor.get("files", {})
+        if not merge:
+            if self._readers:
+                raise RuntimeError(
+                    "seek() must run before iteration starts; use "
+                    "merge=True to adopt positions mid-run")
+            self._seek = {n: dict(e) for n, e in files.items()}
+            self._safe = {n: dict(e) for n, e in files.items()}
+            self._base_rows = int(cursor.get("rows", 0))
+            return self
+        for name, ent in files.items():
+            self._seek[name] = dict(ent)
+            cur = self._safe.get(name)
+            if cur is None or int(ent.get("offset", 0)) \
+                    > int(cur.get("offset", 0)):
+                self._safe[name] = dict(ent)
+            for p, r in self._readers.items():
+                if os.path.basename(p) == name:
+                    if int(ent.get("offset", 0)) > r.offset:
+                        r.offset = int(ent.get("offset", 0))
+                    r.done = r.done or bool(ent.get("done", False))
+        return self
 
     # -- iteration ----------------------------------------------------------
     def _list_files(self):
@@ -251,22 +333,51 @@ class RecordStream:
         # fault site: a dying ('error'), stalling ('hang') or torn-read
         # ('corrupt') tail-follow poll
         mode = faults.trip("stream.tail")
-        for p in self._list_files():
+        listed = self._list_files()
+        self._listed = listed
+        for p in listed:
             if p not in self._readers:
-                self._readers[p] = TailReader(p)
-        order = sorted(self._readers)
-        out = []
+                r = TailReader(p)
+                ent = self._seek.get(os.path.basename(p))
+                if ent:  # resume / partition handover: adopt the offset
+                    r.offset = int(ent.get("offset", 0))
+                    r.done = bool(ent.get("done", False))
+                self._readers[p] = r
+        out = []  # [(record, provenance)] — provenance on the LAST record
+        # of each chunk: (basename, chunk_end_offset, file_drained)
         prev_bad = self.bad_chunks
-        for i, p in enumerate(order):
+        # only the CURRENT listing is polled: a partition-filtered source
+        # that stops listing a file (lease lost) stops being read here
+        for i, p in enumerate(listed):
             r = self._readers[p]
             if r.done:
                 continue
             # rotation contract: a file is sealed once a newer one exists
-            final = (i < len(order) - 1) or self._closed.is_set()
+            final = (i < len(listed) - 1) or self._closed.is_set()
             recs, pending = r.poll(final=final)
-            out.extend(recs)
             if final and not pending:
                 r.done = True
+            base = os.path.basename(p)
+            if not recs:
+                # nothing to deliver for this file: its whole parse state
+                # (skipped bad tails, the done flag) is already safe
+                if r.last_chunks or r.done:
+                    self._safe[base] = {"offset": r.offset,
+                                        "done": bool(r.done)}
+                continue
+            idx = 0
+            for end_off, nrec in r.last_chunks:
+                for j in range(nrec):
+                    prov = None
+                    if j == nrec - 1:
+                        prov = (base, end_off,
+                                bool(r.done) and end_off >= r.offset)
+                    out.append((recs[idx], prov))
+                    idx += 1
+            # bad-chunk rescans can reorder bookkeeping; never drop data
+            while idx < len(recs):
+                out.append((recs[idx], None))
+                idx += 1
         new_bad = self.bad_chunks - prev_bad
         if new_bad:
             self._c_bad_chunks.inc(new_bad)
@@ -274,24 +385,36 @@ class RecordStream:
             self._c_records.inc(len(out))
             self._window.append((self._clock(), self.records_read))
         if mode == "corrupt" and out:
-            out[0] = faults.corrupt_bytes(out[0])
+            out[0] = (faults.corrupt_bytes(out[0][0]), out[0][1])
         return out
 
     def records(self):
-        """Yield record bytes until closed and fully drained."""
+        """Yield record bytes until closed and fully drained (or
+        interrupted — then stop immediately, position preserved)."""
         while True:
+            if self._interrupted.is_set():
+                return
             got = self._poll_once()
-            for rec in got:
+            for rec, prov in got:
                 # same per-record site AsyncExecutor.run drills on the
                 # batch path: 'corrupt' truncates the record so the
                 # bounded max_bad_records skip can be exercised
                 if faults.trip("recordio.read") == "corrupt":
                     rec = faults.corrupt_bytes(rec)
                 yield rec
+                # the record is out the door: chunk boundaries it
+                # completes become part of the durable cursor
+                self._delivered += 1
+                if prov is not None:
+                    base, end_off, drained = prov
+                    self._safe[base] = {"offset": end_off,
+                                        "done": drained}
+                    self._safe_rows = self._delivered
             if got:
                 continue
             if self._closed.is_set():
-                if all(r.done for r in self._readers.values()):
+                if all(self._readers[p].done for p in self._listed
+                       if p in self._readers):
                     return
                 continue  # close raced a partial tail; next poll seals it
             self._sleep(self.poll_interval_s)

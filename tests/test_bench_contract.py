@@ -142,6 +142,20 @@ def test_streaming_bench_record(monkeypatch):
     assert prox["eval_loss_first"] is not None
     assert prox["eval_loss_last"] is not None
     assert prox["improved"] in (True, False)
+    # ISSUE 19 fleet block: takeover can't beat the lease TTL, a cold
+    # 2-target fleet converges through prepare+commit with zero skew,
+    # and a cursor resume replays a bounded, counted row tail
+    fleet = rec["fleet"]
+    assert set(fleet) == {"lease_ttl_s", "reassign_takeover_s",
+                          "partitions_reassigned", "fleet_targets",
+                          "fleet_version", "commit_convergence_s",
+                          "fleet_version_skew", "resume_replayed_rows"}
+    assert fleet["reassign_takeover_s"] >= fleet["lease_ttl_s"] > 0
+    assert fleet["partitions_reassigned"] == 2
+    assert fleet["fleet_targets"] == 2 and fleet["fleet_version"] is not None
+    assert fleet["commit_convergence_s"] > 0
+    assert fleet["fleet_version_skew"] == 0
+    assert 0 <= fleet["resume_replayed_rows"] <= 64  # <= one chunk
     # healthy run: every reliability counter is zero
     rel = rec["reliability"]
     assert set(rel) == {"bad_publishes", "publish_failures",

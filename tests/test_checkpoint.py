@@ -311,3 +311,52 @@ def test_load_staged_falls_back_past_corrupt_newest(tmp_path):
 
     with pytest.raises((IOError, ValueError, zipfile.BadZipFile)):
         checkpoint.load_staged(ckpt, main, version=1)
+
+
+def test_publisher_stop_keeps_pin_until_release(tmp_path):
+    """Regression: ``ModelPublisher.stop()`` used to unpin the served
+    version on the spot — stopping the *watcher* doesn't stop the
+    *serving process*, so the trainer's retention GC could delete the
+    weights live replicas were still using. ``stop()`` must keep the
+    pin; :meth:`release` (or ``stop(unpin=True)``) drops it only once
+    serving shutdown / supersession is confirmed."""
+    from paddle_tpu import checkpoint, streaming
+
+    ckpt, save, _main, _scope = _tiny_saver(tmp_path, "pubpin")
+    save(max_versions=2)
+
+    class Target:
+        def reload(self, _d, version=None):
+            return version
+
+    pub = streaming.ModelPublisher(ckpt, Target(), pin_owner="srv")
+    assert pub.poll_once() == 0
+    assert checkpoint.pinned_versions(ckpt) == {0}
+    pub.stop()  # serving still up: the pin must survive the stop
+    assert checkpoint.pinned_versions(ckpt) == {0}
+    for _ in range(3):  # GC pressure cannot evict the served version
+        save(max_versions=2)
+    assert os.path.isdir(os.path.join(ckpt, "checkpoint_0"))
+    # confirmed shutdown: release drops the pin, the next GC trims it
+    pub.release()
+    assert checkpoint.pinned_versions(ckpt) == set()
+    save(max_versions=2)
+    assert not os.path.isdir(os.path.join(ckpt, "checkpoint_0"))
+
+
+def test_load_extra_reads_cursor_without_arrays(tmp_path):
+    """``load_extra`` returns just the manifest ``extra`` (the fleet's
+    cursor-handover read) and walks back past torn versions."""
+    from paddle_tpu import checkpoint
+
+    ckpt, save, _main, _scope = _tiny_saver(tmp_path, "extra")
+    save(extra_meta={"cursor": {"rows": 7}})
+    save(extra_meta={"cursor": {"rows": 19}})
+    v, extra = checkpoint.load_extra(ckpt)
+    assert v == 1 and extra["cursor"]["rows"] == 19
+    # a torn newest (manifest missing) is invisible, not trusted
+    os.remove(os.path.join(ckpt, "checkpoint_1",
+                           checkpoint._MANIFEST))
+    v, extra = checkpoint.load_extra(ckpt)
+    assert v == 0 and extra["cursor"]["rows"] == 7
+    assert checkpoint.load_extra(str(tmp_path / "void")) == (None, {})

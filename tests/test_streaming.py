@@ -370,6 +370,30 @@ def test_router_fleet_reload_verb(tmp_path):
         with pytest.raises(serving.WorkerFailedError):
             client.reload(str(tmp_path / "nope"))
         assert np.allclose(client.predict(feed)[0], after)
+        # two-phase swap over the same socket plane: prepare CRC-stages
+        # on every worker without serving it, commit flips the fleet
+        got = client.prepare(ckpt_dir, version=0)
+        assert got["version"] == 0 and len(got["workers"]) == 2
+        got = client.commit(version=0)
+        assert got["version"] == 0
+        # a staged-then-aborted round leaves serving untouched
+        client.prepare(ckpt_dir, version=0)
+        client.abort()
+        assert np.allclose(client.predict(feed)[0], after)
+        # a bad prepare is all-or-nothing: typed failure, nothing staged
+        with pytest.raises(serving.WorkerFailedError):
+            client.prepare(str(tmp_path / "nope"))
+        # the worker stats verb reports the served version, and the
+        # router's metrics surface it per worker (heartbeat-refreshed)
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline:
+            stats = [w["stats"] for w in client.metrics()["workers"]]
+            if all(s.get("serve_version") == 0 for s in stats):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("serve_version never surfaced: %r"
+                                 % (client.metrics()["workers"],))
         client.close()
 
 
@@ -513,3 +537,394 @@ def test_soak_router_two_workers_hot_swap(tmp_path):
         p99 = sorted(latencies)[max(0, int(0.99 * len(latencies)) - 1)]
         assert p99 < 10.0
         client.close()
+
+
+# -- fleet-coordinated continuous learning ----------------------------------
+# (durable ingest cursors, partition leases, host loss, two-phase swap)
+
+def _rows(n, start=0):
+    return [("row-%06d" % i).encode() for i in range(start, start + n)]
+
+
+def _write_chunks(path, rows, chunk=8):
+    for i in range(0, len(rows), chunk):
+        streaming.write_records(path, rows[i:i + chunk])
+
+
+def test_cursor_is_delivered_boundary_not_parse_position(tmp_path):
+    """The resume cursor must reflect rows DELIVERED to the consumer,
+    not rows parsed: one poll parses the whole backlog, and a parse-time
+    cursor would make a restart SKIP everything still in flight
+    (at-most-once = silent loss). The safe point trails at the last
+    fully-delivered chunk boundary; a resume re-reads a bounded tail."""
+    data = str(tmp_path)
+    rows = _rows(48)
+    _write_chunks(os.path.join(data, "part-00000.recordio"), rows)
+    s = _drained(data)
+    it = s.records()
+    got = [next(it) for _ in range(20)]  # 2.5 chunks of 8 delivered
+    cur = s.cursor()
+    assert cur["rows"] == 16  # chunk boundary, not 20 (and not 48)
+    ent = cur["files"]["part-00000.recordio"]
+    assert ent["offset"] > 0 and not ent["done"]
+
+    s2 = _drained(data)
+    s2.seek(cur)
+    rest = list(s2.records())
+    assert s2.rows_total == 48  # adopted rows + redelivered tail
+    assert rest[0] == rows[16]  # resume lands exactly on the boundary
+    # at-least-once, bounded: nothing lost, <= one chunk seen twice
+    assert set(got) | set(rest) == set(rows)
+    assert 0 <= len(got) + len(rest) - len(rows) <= 8
+
+
+def test_cursor_marks_drained_files_done_and_skips_them(tmp_path):
+    data = str(tmp_path)
+    a = _rows(16)
+    _write_chunks(os.path.join(data, "part-00000.recordio"), a)
+    s = _drained(data)
+    assert list(s.records()) == a
+    cur = s.cursor()
+    assert cur["rows"] == 16
+    assert cur["files"]["part-00000.recordio"]["done"]
+    b = _rows(8, start=500)
+    _write_chunks(os.path.join(data, "part-00001.recordio"), b)
+    s2 = _drained(data)
+    s2.seek(cur)
+    assert list(s2.records()) == b  # the sealed file is not re-read
+    assert s2.rows_total == 24
+    # seek after iteration started is a usage error (merge= is the
+    # mid-run path); the cursor survives JSON (it crosses hosts)
+    import json
+
+    assert json.loads(json.dumps(cur)) == cur
+    with pytest.raises(RuntimeError):
+        s2.seek(cur)
+
+
+def test_lease_takeover_and_split_brain_guard(tmp_path):
+    """Two hosts split 4 partitions under target_share; one stops
+    renewing and past the TTL the survivor reclaims its leases PAST the
+    share (dead partitions have nowhere else to go). The returning
+    zombie's renewal detects the reclamation and drops ownership loudly
+    instead of double-reading."""
+    clk = [1000.0]
+
+    def mk(host):
+        return streaming.PartitionCoordinator(
+            str(tmp_path), host, num_partitions=4, ttl_s=5.0,
+            target_share=2, clock=lambda: clk[0])
+
+    a, b = mk("a"), mk("b")
+    a.poll()
+    b.poll()
+    assert len(a.owned) == 2 and len(b.owned) == 2
+    assert (a.owned | b.owned) == {0, 1, 2, 3}
+    clk[0] += 3.0
+    a.poll()
+    b.poll()  # healthy fleet: shares hold, no churn
+    assert len(a.owned) == 2 and len(b.owned) == 2 and b.reassigned == 0
+
+    dead = set(a.owned)
+    clk[0] += 6.0  # host a missed every heartbeat past the TTL
+    gained = b.poll()
+    assert gained == dead and b.owned == {0, 1, 2, 3}
+    assert b.reassigned == 2
+    ev = flight.RECORDER.events(kind="lease.reassign")
+    assert ev and ev[-1]["expired_for_s"] > 0
+    a.renew()  # the zombie returns: ownership is gone, loudly
+    assert a.owned == set() and a.lost == 2
+    assert flight.RECORDER.events(kind="lease.lost")
+
+
+def test_torn_lease_reclaimed_not_trusted(tmp_path):
+    clk = [0.0]
+    a = streaming.PartitionCoordinator(
+        str(tmp_path), "a", num_partitions=1, ttl_s=5.0,
+        clock=lambda: clk[0])
+    assert a.poll() == {0}
+    # a dies mid-renewal: a half-written (unparseable) lease lands
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "lease.renew:corrupt@1")):
+        a.renew()
+    b = streaming.PartitionCoordinator(
+        str(tmp_path), "b", num_partitions=1, ttl_s=5.0,
+        clock=lambda: clk[0])
+    # no TTL wait: wreckage is reclaimed immediately, never trusted
+    assert b.poll() == {0} and b.reassigned == 1
+    assert flight.RECORDER.events(kind="lease.reassign")[-1]["torn"]
+    # injected missed heartbeats are counted, not fatal
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "lease.renew:error@1")):
+        b.renew()
+    assert b.renew_failures == 1 and b.owned == {0}
+
+
+def test_host_loss_drill_fast_fake_clock(tmp_path):
+    """Tier-1 host-loss drill (stream-level, shared fake lease clock):
+    host A consumes part of its partition share, publishes its cursor,
+    and dies. Host B reclaims A's partitions, adopts the published
+    cursor mid-file, and drains. Audit: every row delivered at least
+    once, the replay bounded by chunk size per file and COUNTED —
+    nothing silently lost, nothing silently re-read."""
+    import json
+
+    data = str(tmp_path / "data")
+    os.makedirs(data)
+    ckpt_a = str(tmp_path / "ckpt_a")
+    names = ["part-%05d.recordio" % i for i in range(4)]
+    all_rows, by_file = [], {}
+    for i, n in enumerate(names):
+        by_file[n] = _rows(32, start=1000 * i)
+        all_rows += by_file[n]
+        _write_chunks(os.path.join(data, n), by_file[n])
+
+    clk = [0.0]
+
+    def mk(host):
+        return streaming.PartitionCoordinator(
+            data, host, num_partitions=2, ttl_s=5.0, target_share=1,
+            clock=lambda: clk[0])
+
+    a, b = mk("a"), mk("b")
+    a.poll()
+    b.poll()
+    assert a.owned and b.owned and not (a.owned & b.owned)
+
+    sa = streaming.RecordStream(a.source(), poll_interval_s=0.0,
+                                sleep=lambda _t: None)
+    sa.close()
+    a_files = [n for n in names
+               if streaming.partition_of(n, 2) in a.owned]
+    a_total = sum(len(by_file[n]) for n in a_files)
+    it = sa.records()
+    seen_a = [next(it) for _ in range(a_total // 2)]
+    # A publishes its cursor — the manifest write is atomic, so a
+    # version either carries its cursor or is invisible — then DIES
+    vdir = os.path.join(ckpt_a, "checkpoint_0")
+    os.makedirs(vdir)
+    with open(os.path.join(vdir, checkpoint._MANIFEST), "w") as f:
+        json.dump({"extra": {"cursor": sa.cursor()}}, f)
+
+    clk[0] += 6.0  # past the TTL with no renewals from A
+    gained = b.poll()
+    assert gained == a.owned and b.reassigned == len(gained)
+
+    frag = b.partition_cursor([ckpt_a], gained)
+    assert set(frag["files"]) <= set(a_files) and frag["rows"] > 0
+    sb = streaming.RecordStream(b.source(), poll_interval_s=0.0,
+                                sleep=lambda _t: None)
+    sb.seek(frag)
+    sb.close()
+    seen_b = list(sb.records())
+    # nothing lost: A's delivered rows + B's drain cover every row
+    assert set(seen_a) | set(seen_b) == set(all_rows)
+    # bounded, counted replay: at most one chunk per adopted file
+    replay = len(seen_a) + len(seen_b) - len(all_rows)
+    assert 0 <= replay <= 8 * len(a_files)
+    assert sb.rows_total == frag["rows"] + len(seen_b)
+
+
+def test_published_cursor_resume_counts_replay_then_preemption(trained):
+    """Restart-resume: a fresh trainer process adopts weights AND ingest
+    position from the SAME newest intact version, counts its bounded
+    replay, and keeps training. Then a preemption notice (SIGTERM path)
+    finishes the micro-batch and flushes checkpoint+cursor under the
+    grace budget."""
+    trainer, data_dir, ckpt_dir = trained
+    w = trainer.publish()  # a fresh version carrying the live cursor
+    assert w.wait() and w.error is None
+    v, extra = checkpoint.load_extra(ckpt_dir)
+    assert extra.get("cursor", {}).get("rows", 0) > 0
+
+    t2 = streaming.StreamingTrainer(
+        ckpt_dir, batch_size=16, publish_every_steps=5, max_versions=3,
+        hidden_sizes=(16,), holdout_batches=2)
+    s2 = _drained(data_dir)
+    assert t2.resume(s2) == v and t2.resumed_version == v
+    assert t2.step == extra["step"]
+    assert 0 <= t2.replayed_rows <= 64  # at most one chunk re-read
+    assert s2.cursor()["rows"] == extra["cursor"]["rows"]
+    resumed_at = t2.step
+
+    # preemption notice mid-run: finish the micro-batch, stop, flush
+    def notice(tr):
+        if tr.step == resumed_at + 3:
+            tr.preempted.set()
+            s2.interrupt()
+
+    assert t2.run(s2, on_step=notice) == resumed_at + 3
+    assert t2.flush(grace_s=30.0)
+    nv, nextra = checkpoint.load_extra(ckpt_dir)
+    assert nextra["step"] == t2.step  # the flush landed THIS position
+    assert nextra["cursor"]["rows"] >= extra["cursor"]["rows"]
+    assert flight.RECORDER.events(kind="preempt.flush")[-1]["ok"]
+    t2.close()
+
+
+def test_cursor_write_fault_never_lands_cursorless_version(trained):
+    """``cursor.write:error`` fails the WHOLE publish — a version
+    without its cursor would resume from nothing (silent full replay at
+    best, silent skip at worst). ``corrupt`` zeroes the offsets: the
+    resume replays everything, but counted, never skipping."""
+    trainer, _data, ckpt_dir = trained
+    before_v = checkpoint.candidate_versions(ckpt_dir)[0]
+    before_f = trainer.publish_failures
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "cursor.write:error@1")):
+        assert trainer.publish() is None
+    assert trainer.publish_failures == before_f + 1
+    assert checkpoint.candidate_versions(ckpt_dir)[0] == before_v
+
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "cursor.write:corrupt@1")):
+        w = trainer.publish()
+    assert w is not None and w.wait() and w.error is None
+    v, extra = checkpoint.load_extra(ckpt_dir)
+    assert v != before_v
+    assert extra["cursor"] == {"rows": 0, "files": {}}
+
+
+def test_fleet_publisher_two_phase_swap_drill(trained):
+    """The fleet swap discipline end to end: a clean round converges
+    both targets; a commit-faulted round quarantines the straggler
+    (partial_commit flight event, skew gauge, old version stays pinned
+    while it still serves); readmit heals; a prepare failure aborts the
+    whole round with NOTHING swapped."""
+    from paddle_tpu.reliability.policy import RetryPolicy
+
+    trainer, _data, ckpt_dir = trained
+    e1 = serving.ServingEngine(trainer.serve_dir, num_replicas=1)
+    e2 = serving.ServingEngine(trainer.serve_dir, num_replicas=1)
+    fp = streaming.FleetPublisher(
+        ckpt_dir, {"a": e1, "b": e2},
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                          sleep=lambda _s: None))
+    v1 = checkpoint.candidate_versions(ckpt_dir)[0]
+    assert fp.poll_once() == v1 and fp.version_skew() == 0
+    assert e1.serve_version == v1 and e2.serve_version == v1
+    assert fp.poll_once() is None  # converged: nothing to do
+
+    # fresh publish; target b's commit dies past its retry budget
+    w = trainer.publish()
+    assert w.wait() and w.error is None
+    v2 = checkpoint.candidate_versions(ckpt_dir)[0]
+    assert v2 != v1
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "swap.commit:error@2-3")), pytest.warns(RuntimeWarning):
+        assert fp.poll_once() == v2
+    assert fp.quarantined == {"b"} and fp.version_skew() == 1
+    assert e1.serve_version == v2 and e2.serve_version == v1
+    ev = flight.RECORDER.events(kind="publish.partial_commit")
+    assert ev[-1]["target"] == "b" and ev[-1]["attempts"] == 2
+    assert "paddle_tpu_stream_fleet_version_skew 1" \
+        in fp.registry.prometheus_text()
+    # mixed fleet: BOTH versions stay pinned (b still serves v1)
+    assert {v1, v2} <= checkpoint.pinned_versions(ckpt_dir)
+
+    fp.readmit("b")
+    assert fp.poll_once() == v2 and fp.version_skew() == 0
+    assert e2.serve_version == v2
+    assert v1 not in checkpoint.pinned_versions(ckpt_dir)
+
+    # prepare failure on ANY target aborts the round: nothing swaps
+    w = trainer.publish()
+    assert w.wait() and w.error is None
+    v3 = checkpoint.candidate_versions(ckpt_dir)[0]
+    with faults.fault_scope(faults.FaultPlan.from_spec(
+            "swap.prepare:error@2")), pytest.warns(RuntimeWarning):
+        assert fp.poll_once() is None
+    assert fp.prepare_failures == 1
+    assert e1.serve_version == v2 and e2.serve_version == v2
+    assert e1._staged_swap is None and e2._staged_swap is None
+    assert flight.RECORDER.events(kind="publish.prepare_failed")
+    # next clean round converges on the blocked version
+    assert fp.poll_once() == v3 and fp.version_skew() == 0
+    fp.release()
+    e1.shutdown()
+    e2.shutdown()
+
+
+@pytest.mark.slow
+def test_host_loss_drill_subprocess_sigkill(tmp_path):
+    """The real thing: two trainer processes split the stream by
+    partition lease; one is SIGKILLed mid-stream (no goodbye, no lease
+    release). The survivor reclaims the dead host's partitions past the
+    TTL, adopts its published cursor from ``--peer-dirs``, and finishes
+    its step budget — with the takeover visible in its exit report and
+    flight dump, and the dead host's overshoot counted as replay."""
+    import json
+    import signal
+    import subprocess
+    import sys
+
+    from paddle_tpu.streaming.trainer import TRAINER_READY_PREFIX
+
+    data = str(tmp_path / "data")
+    ckpt_a, ckpt_b = str(tmp_path / "ckpt_a"), str(tmp_path / "ckpt_b")
+    flight_dir = str(tmp_path / "flight")
+    streaming.synthesize_stream_files(data, n_files=4, rows_per_file=64,
+                                      seed=3, chunk_rows=16)
+
+    def spawn(host, ckpt, peer, steps):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   PADDLE_TPU_FLIGHT=flight_dir)
+        return subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.streaming.trainer",
+             "--data-dir", data, "--ckpt-dir", ckpt,
+             "--steps", str(steps), "--publish-every", "2",
+             "--batch-size", "16", "--poll-interval", "0.02",
+             "--partitions", "2", "--num-hosts", "2",
+             "--lease-ttl", "1.0", "--host-id", host,
+             "--peer-dirs", peer],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env)
+
+    pa = spawn("host-a", ckpt_a, ckpt_b, steps=999)
+    pb = spawn("host-b", ckpt_b, ckpt_a, steps=30)
+    try:
+        for proc in (pa, pb):
+            for line in proc.stdout:
+                if line.startswith(TRAINER_READY_PREFIX):
+                    break
+        # wait until A has published at least one version (its cursor
+        # must be adoptable), then kill it dead — no lease release
+        deadline = time.monotonic() + 120.0
+        while not checkpoint.candidate_versions(ckpt_a):
+            assert time.monotonic() < deadline, "host-a never published"
+            time.sleep(0.1)
+        pa.kill()
+        pa.wait()
+
+        # keep the firehose alive so the survivor can finish its budget
+        start = 256
+        result = None
+        while time.monotonic() < deadline:
+            if pb.poll() is not None:
+                for line in pb.stdout:
+                    line = line.strip()
+                    if line.startswith("{"):
+                        result = json.loads(line)
+                break
+            streaming.synthesize_stream_files(
+                data, n_files=4, rows_per_file=16, seed=3,
+                start_index=start, chunk_rows=16)
+            start += 64
+            time.sleep(0.3)
+        assert result is not None, "survivor never exited"
+        assert pb.returncode == 0
+    finally:
+        for proc in (pa, pb):
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait()
+
+    assert result["steps"] == 30 and result["publish_failures"] == 0
+    # the survivor ended owning EVERY partition, at least one by takeover
+    assert result["partitions_owned"] == [0, 1]
+    assert result["reassigned"] >= 1
+    assert result["replayed_rows"] >= 0
+    # the takeover is reconstructible from the flight dumps
+    dumps = flight.load_dir(flight_dir)
+    kinds = [e["kind"] for d in dumps for e in d["events"]]
+    assert "lease.reassign" in kinds

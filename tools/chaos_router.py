@@ -22,6 +22,12 @@ dumped ring against the accepted-request ledger — every request accepted
 after warm-up must appear as a ``request.outcome`` event, and a kill
 drill must have left ``worker.respawn`` evidence. A ledger/dump mismatch
 fails the drill exactly like a silent loss would.
+
+Since ISSUE 19 the drill also audits **version observability**: every
+worker's heartbeat stats must carry ``serve_version`` (which model
+version it is serving right now) and the fleet must agree — the
+two-phase swap plane steers by exactly this signal, so a worker that
+cannot report it is un-auditable and fails the drill.
 """
 
 import argparse
@@ -78,7 +84,7 @@ def main(argv=None):
                "kill": bool(args.kill), "faults": args.faults,
                "accepted": 0, "completed": 0, "typed_errors": {},
                "silent_losses": 0, "respawns": 0, "recovered": None,
-               "flight": None}
+               "serve_versions": None, "flight": None}
     try:
         router.start()
         client = RouterClient(router.address, pool_size=8)
@@ -118,6 +124,18 @@ def main(argv=None):
             except Exception:
                 summary["recovered"] = False
         summary["respawns"] = router.metrics_.snapshot()["respawns"]
+        # version-audit: every worker's heartbeat stats must report which
+        # model version it serves (the fleet-swap plane steers by this;
+        # a worker whose stats omit it is un-auditable). Stats refresh on
+        # the heartbeat, so give the loop a couple of intervals.
+        t0 = time.time()
+        while time.time() - t0 < 10.0:
+            stats = [w["stats"] for w in client.metrics()["workers"]]
+            if stats and all("serve_version" in s for s in stats):
+                summary["serve_versions"] = [
+                    s["serve_version"] for s in stats]
+                break
+            time.sleep(0.2)
         client.close()
     finally:
         router.shutdown()
@@ -126,6 +144,8 @@ def main(argv=None):
                                       kill=args.kill)
     ok = (summary["silent_losses"] == 0 and summary["completed"] > 0
           and summary["recovered"] is not False
+          and summary["serve_versions"] is not None
+          and len(set(summary["serve_versions"])) == 1  # no version skew
           and summary["flight"]["audit"] == "ok")
     summary["verdict"] = "ok" if ok else "FAIL"
     print(json.dumps(summary))

@@ -181,6 +181,10 @@ def main(argv=None):
                 summary["request_errors"] += 1
         summary["candidates"] = versions
         summary["served_version"] = pub.served_version
+        # version-audit: the serving plane's own report of what it runs
+        # must match the publisher's belief — a divergence here is the
+        # skew the fleet swap plane exists to prevent
+        summary["engine_serve_version"] = eng.serve_version
         summary["swap_count"] = pub.swap_count
         summary["bad_publishes"] = pub.bad_publishes
     finally:
@@ -195,6 +199,7 @@ def main(argv=None):
           and summary["bad_publishes"] >= 1
           and summary["served_version"] is not None
           and summary["served_version"] != newest
+          and summary["engine_serve_version"] == summary["served_version"]
           and summary["flight"]["audit"] == "ok"
           and (args.smoke or (summary["killed_mid_publish"]
                               and summary["torn_versions"] >= 1

@@ -24,6 +24,11 @@
 #      version corrupted on disk — the publisher must fall back to the
 #      previous intact version mid-burst with zero failed requests and
 #      a flight dump that proves it.
+#   6. the fleet chaos smoke (`tools/chaos_fleet.py --smoke`, ISSUE 19):
+#      deterministic fake-clock drills for the multi-host loop — a
+#      mid-file death resumed exactly-once from its cursor, a lease
+#      takeover past the TTL, and a two-phase fleet swap that
+#      quarantines (then heals) a commit-faulted straggler.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -46,5 +51,7 @@ JAX_PLATFORMS=cpu "$PY" tools/chaos_router.py --smoke
 JAX_PLATFORMS=cpu "$PY" tools/trace_view.py --smoke
 
 JAX_PLATFORMS=cpu "$PY" tools/chaos_stream.py --smoke
+
+JAX_PLATFORMS=cpu "$PY" tools/chaos_fleet.py --smoke
 
 echo "lint.sh: ok"
