@@ -46,6 +46,18 @@ def _lm_step_spec():
                      fetches={v.name: v for v in fetch_vars})
 
 
+def _lm_chunk_spec():
+    """Inference-only zoo entry for the K-token prefill/verify chunk
+    program (ISSUE 20) — same weight-sharing family as lm_step."""
+    from .. import models
+    from ..models.common import ModelSpec
+
+    fetch_vars, _spec = models.transformer.transformer_lm_chunk(
+        vocab=64, d_model=32, d_ff=64, n_head=2, n_layer=2, ctx_cap=16)
+    return ModelSpec(None, feeds={},
+                     fetches={v.name: v for v in fetch_vars})
+
+
 def _zoo_builders():
     """name -> zero-arg builder, CPU-sized configs (mirrors tests/
     test_models.py). Each builds into the CURRENT default program."""
@@ -73,6 +85,8 @@ def _zoo_builders():
         # only — the ISSUE 14 acceptance gate "decode programs verify
         # clean"); fetches are the logits + carried caches
         "transformer.lm_step": _lm_step_spec,
+        # the chunked-prefill / speculative-verify sibling (ISSUE 20)
+        "transformer.lm_chunk": _lm_chunk_spec,
         "bert": lambda: models.bert.bert_base(
             vocab_size=64, seq_len=16, d_model=32, d_ff=64, n_head=2,
             n_layer=2, dropout_rate=0.1),

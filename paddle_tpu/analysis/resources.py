@@ -192,24 +192,30 @@ def cache_budget():
         return DEFAULT_CACHE_BUDGET
 
 
-def decode_cache_verdict(spec, ladder, ctx_ladder, budget=None):
+def decode_cache_verdict(spec, ladder, ctx_ladder, budget=None,
+                         prefill_ladder=None):
     """Prove the serving decode tier's compile-cache bound from the
     ladders: the scheduler dispatches (and ``warmup`` pre-compiles) one
-    executable per (batch rung, ctx rung) pair, so the bound is
-    ``len(ladder) * len(ctx_ladder)`` — structural, not empirical
-    (duplicate rungs are deduped the way ``DecodeBatcher`` dedups them).
-    Returns ``(bound, AnalysisResult)``: a finding when the bound
-    exceeds the budget, plus one for each ctx rung above the decode
-    spec's ``ctx_cap`` (suspect ladder config: the step program was
-    sized for ``ctx_cap``, so a larger rung is paying compile + cache
-    memory for geometries the model was not built to use — still
-    counted in the bound, because nothing stops it being dispatched)."""
+    step executable per (batch rung, ctx rung) pair and — when a chunked
+    prefill/verify program rides along (``prefill_ladder``) — one chunk
+    executable per (batch rung, ctx rung, prefill rung) triple, so the
+    bound is ``len(ladder) * len(ctx_ladder) * (1 + len(prefill_ladder))``
+    — structural, not empirical (duplicate rungs are deduped the way
+    ``DecodeBatcher`` dedups them). Returns ``(bound, AnalysisResult)``:
+    a finding when the bound exceeds the budget, plus one for each ctx
+    rung above the decode spec's ``ctx_cap`` and one for each prefill
+    rung above it (suspect ladder config: the programs were sized for
+    ``ctx_cap``, so a larger rung is paying compile + cache memory for
+    geometries the model was not built to use — still counted in the
+    bound, because nothing stops it being dispatched)."""
     budget = cache_budget() if budget is None else int(budget)
     cap = int(spec.get("ctx_cap", 0) or 0) if isinstance(spec, dict) else 0
     ladder = tuple(sorted(set(ladder or ())))
     ctx_ladder = tuple(sorted(set(ctx_ladder or ())))
+    prefill_ladder = tuple(sorted(set(prefill_ladder or ())))
     suspect = tuple(c for c in ctx_ladder if cap and c > cap)
-    bound = max(len(ladder), 1) * max(len(ctx_ladder), 1)
+    bound = max(len(ladder), 1) * max(len(ctx_ladder), 1) \
+        * (1 + len(prefill_ladder))
     diags = []
     for c in suspect:
         diags.append(Diagnostic(
@@ -219,13 +225,25 @@ def decode_cache_verdict(spec, ladder, ctx_ladder, budget=None):
             "compile time and cache memory on a geometry the model was "
             "not built for (drop it, or rebuild the step with a larger "
             "capacity)" % (c, cap, cap)))
+    for k in (p for p in prefill_ladder if cap and p > cap):
+        diags.append(Diagnostic(
+            "warning", "compile-cache",
+            "prefill ladder rung %d exceeds the decode spec's cache "
+            "capacity %d — a chunk can never be longer than the cache it "
+            "writes into, so this rung compiles a geometry no admissible "
+            "prompt dispatches (drop it) — still counted in the bound, "
+            "because nothing stops it being dispatched" % (k, cap)))
     if bound > budget:
+        chunk_note = ("%d batch rungs x %d ctx rungs"
+                      % (max(len(ladder), 1), max(len(ctx_ladder), 1)))
+        if prefill_ladder:
+            chunk_note += (" x (1 step + %d chunk rungs)"
+                           % len(prefill_ladder))
         diags.append(Diagnostic(
             "warning", "compile-cache",
             "decode bucket ladders compile up to %d executables "
-            "(%d batch rungs x %d ctx rungs), over the %d budget — "
+            "(%s), over the %d budget — "
             "warmup and XLA cache memory scale with this product "
             "(PADDLE_TPU_COMPILE_CACHE_BUDGET overrides)"
-            % (bound, max(len(ladder), 1), max(len(ctx_ladder), 1),
-               budget)))
+            % (bound, chunk_note, budget)))
     return bound, AnalysisResult(diags)

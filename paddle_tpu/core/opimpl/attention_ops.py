@@ -81,6 +81,54 @@ def _cached_attention(env, op):
     put(env, op.output("Out"), ctx.reshape(b, hd))
 
 
+@register("kv_cache_write_chunk")
+def _kv_cache_write_chunk(env, op):
+    """K-row cache update (the chunked-prefill / speculative-verify
+    sibling of ``kv_cache_write``): Cache [B, C, ...], X [B, K, ...],
+    Pos [B, K] -> Out[b, Pos[b, j]] = X[b, j]. Still strictly per-row —
+    row b scatters only into its own cache rows, so the batcher's
+    solo-vs-batched parity property carries over to chunk dispatches.
+    Out-of-range positions drop: the scheduler pads partial chunks with
+    Pos = capacity, so a padded lane writes nothing."""
+    cache = get(env, op.input("Cache"))
+    x = get(env, op.input("X"))
+    pos = get(env, op.input("Pos")).astype(jnp.int32)
+    b = cache.shape[0]
+    put(env, op.output("Out"),
+        cache.at[jnp.arange(b)[:, None], pos].set(x.astype(cache.dtype),
+                                                  mode="drop"))
+
+
+@register("cached_attention_chunk")
+def _cached_attention_chunk(env, op):
+    """K-query attention over a fixed-capacity KV cache: Q [B, K, H*D],
+    CacheK/CacheV [B, C, H*D], Pos [B, K] (the index each query token
+    was just written at). Query j of row b attends cache positions
+    <= Pos[b, j] — per-query causal masking over the filled prefix plus
+    the chunk's own earlier tokens, exactly the step op's semantics
+    applied K times. Numerics mirror ``cached_attention`` (1/sqrt(D)
+    scale, f32 softmax); still strictly per-row."""
+    q = get(env, op.input("Q"))
+    k = get(env, op.input("CacheK"))
+    v = get(env, op.input("CacheV"))
+    pos = get(env, op.input("Pos")).astype(jnp.int32)
+    h = int(op.attr("num_heads", 1))
+    b, c, hd = k.shape
+    kq = q.shape[1]
+    d = hd // h
+    qh = q.reshape(b, kq, h, d)
+    kh = k.reshape(b, c, h, d)
+    vh = v.reshape(b, c, h, d)
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bchd->bhqc", qh, kh) * scale
+    mask = jnp.arange(c)[None, None, None, :] <= pos[:, None, :, None]
+    logits = jnp.where(mask, logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    ctx = jnp.einsum("bhqc,bchd->bqhd", probs, vh)
+    put(env, op.output("Out"), ctx.reshape(b, kq, hd))
+
+
 @register("sampling_id")
 def _sampling_id(env, op):
     x = get(env, op.input("X"))  # [B, C] probabilities

@@ -436,6 +436,33 @@ def _cached_attention_cost(ctx, op):
             hbm_bytes=(2 * b * cap * hd + 2 * b * hd) * e)
 
 
+@register_cost("kv_cache_write_chunk")
+def _kv_cache_write_chunk_cost(ctx, op):
+    n = _nel(ctx, op.input("X"))
+    if n is None:
+        ctx.add(op, unresolved=True)
+        return
+    e = ctx.esize(op.input("X"))
+    ctx.add(op, hbm_bytes=2 * n * e)  # read chunk slice, write rows
+
+
+@register_cost("cached_attention_chunk")
+def _cached_attention_chunk_cost(ctx, op):
+    ks = ctx.shape(op.input("CacheK"))
+    qs = ctx.shape(op.input("Q"))
+    if ks is None or qs is None or len(ks) != 3 or len(qs) != 3:
+        ctx.add(op, unresolved=True)
+        return
+    b, cap, hd = ks
+    kq = qs[1]
+    if kq == -1 or cap == -1:
+        ctx.add(op, unresolved=True)
+        return
+    e = ctx.esize(op.input("Q"))
+    ctx.add(op, flops=4.0 * b * kq * cap * hd,
+            hbm_bytes=(2 * b * cap * hd + 2 * b * kq * hd) * e)
+
+
 # ---------------------------------------------------------------------------
 # optimizer updates: master-precision (f32) state passes, batch-amortized
 # ---------------------------------------------------------------------------

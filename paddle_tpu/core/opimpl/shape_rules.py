@@ -819,3 +819,58 @@ def _cached_attention_shape(ctx, op):
         ctx.set(op.output("Out"), qs, dt)
         return
     ctx.set(op.output("Out"), tuple(qs[:-1]) + (vs[-1],), dt)
+
+
+@register_shape("kv_cache_write_chunk")
+def _kv_cache_write_chunk_shape(ctx, op):
+    cs = ctx.shape(op.input("Cache"))
+    xs = ctx.shape(op.input("X"))
+    dt = ctx.dtype(op.input("Cache"))
+    if cs is not None and xs is not None:
+        if len(xs) != len(cs):
+            raise ShapeError(
+                "kv_cache_write_chunk X '%s' %s must be [B, K, ...] with "
+                "the same rank as Cache '%s' %s (K rows scatter into the "
+                "capacity axis)" % (op.input("X").name, list(xs),
+                                    op.input("Cache").name, list(cs)))
+        for a, b in zip((xs[0],) + tuple(xs[2:]),
+                        (cs[0],) + tuple(cs[2:])):
+            if a != -1 and b != -1 and a != b:
+                raise ShapeError(
+                    "kv_cache_write_chunk X '%s' %s does not slot into "
+                    "Cache '%s' %s" % (op.input("X").name, list(xs),
+                                       op.input("Cache").name, list(cs)))
+    ctx.set(op.output("Out"), cs, dt)
+
+
+@register_shape("cached_attention_chunk")
+def _cached_attention_chunk_shape(ctx, op):
+    qs = ctx.shape(op.input("Q"))
+    ks = ctx.shape(op.input("CacheK"))
+    vs = ctx.shape(op.input("CacheV"))
+    dt = ctx.dtype(op.input("Q"))
+    h = int(op.attr("num_heads", 1))
+    if qs is not None and len(qs) != 3:
+        raise ShapeError("cached_attention_chunk Q '%s' must be "
+                         "[B, K, H*D], got %s"
+                         % (op.input("Q").name, list(qs)))
+    if ks is not None:
+        if len(ks) != 3:
+            raise ShapeError("cached_attention_chunk CacheK '%s' must be "
+                             "[B, C, H*D], got %s"
+                             % (op.input("CacheK").name, list(ks)))
+        if ks[-1] != -1 and ks[-1] % h != 0:
+            raise ShapeError(
+                "cached_attention_chunk CacheK '%s' last dim %d is not "
+                "divisible by num_heads=%d"
+                % (op.input("CacheK").name, ks[-1], h))
+    if qs is not None and ks is not None and qs[-1] != -1 \
+            and ks[-1] != -1 and qs[-1] != ks[-1]:
+        raise ShapeError(
+            "cached_attention_chunk Q '%s' feature dim %d != CacheK "
+            "'%s' dim %d" % (op.input("Q").name, qs[-1],
+                             op.input("CacheK").name, ks[-1]))
+    if vs is None or qs is None:
+        ctx.set(op.output("Out"), qs, dt)
+        return
+    ctx.set(op.output("Out"), tuple(qs[:-1]) + (vs[-1],), dt)

@@ -34,6 +34,7 @@ __all__ = [
     "resize_nearest", "grid_sampler", "pixel_shuffle", "im2sequence",
     "multi_head_attention", "scaled_dot_product_attention",
     "cached_multi_head_attention", "kv_cache_write",
+    "cached_multi_head_attention_chunk", "kv_cache_write_chunk",
     "row_conv", "autoincreased_step_counter", "cos_sim",
     "split", "warpctc", "nce", "hsigmoid", "cumsum",
     "linear_chain_crf", "crf_decoding",
@@ -1778,6 +1779,66 @@ def cached_multi_head_attention(x, cache_k, cache_v, pos, d_model=None,
     ctx = helper.create_variable_for_type_inference(
         dtype=dtype, shape=tuple(x.shape[:-1]) + (d_model,))
     helper.append_op("cached_attention",
+                     {"Q": q, "CacheK": new_k, "CacheV": new_v, "Pos": pos},
+                     {"Out": ctx}, {"num_heads": n_head})
+    wo = helper.create_parameter(
+        ParamAttr(name=None if name is None else name + ".out",
+                  initializer=XavierInitializer(), sharding=("mp", None)),
+        shape=[d_model, d_model], dtype=dtype)
+    out = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=tuple(x.shape[:-1]) + (d_model,))
+    helper.append_op("matmul", {"X": ctx, "Y": wo}, {"Out": out}, {})
+    return out, new_k, new_v
+
+
+def kv_cache_write_chunk(cache, x, pos, name=None):
+    """K-row KV-cache update: ``cache[b, pos[b, j]] = x[b, j]`` (see
+    ``core/opimpl/attention_ops.py``). ``cache``: [B, C, ...], ``x``:
+    [B, K, ...], ``pos``: [B, K] int. Out-of-range positions drop, so a
+    padded chunk lane writes nothing. Returns the updated cache."""
+    helper = LayerHelper("kv_cache_write_chunk", name=name)
+    out = helper.create_variable_for_type_inference(
+        dtype=_dtype(cache), shape=cache.shape)
+    helper.append_op("kv_cache_write_chunk",
+                     {"Cache": cache, "X": x, "Pos": pos}, {"Out": out}, {})
+    return out
+
+
+def cached_multi_head_attention_chunk(x, cache_k, cache_v, pos,
+                                      d_model=None, n_head=1, name=None):
+    """K-token incremental attention sharing
+    :func:`multi_head_attention`'s weights (same ``name`` -> same
+    ``name.q/.k/.v/.out`` parameters) — the chunked-prefill /
+    speculative-verify sibling of :func:`cached_multi_head_attention`:
+    project a K-token chunk ``x`` [B, K, d_model], write its K/V rows
+    into the fixed-capacity caches at each row's own ``pos`` [B, K],
+    attend each query over the filled prefix plus the chunk's earlier
+    tokens (per-query causal mask ``c <= pos[b, j]``), and apply the
+    output projection. Returns ``(out [B, K, d_model], new_cache_k,
+    new_cache_v)``."""
+    helper = LayerHelper("cached_multi_head_attention_chunk", name=name)
+    d_model = d_model or x.shape[-1]
+    dtype = _dtype(x)
+
+    def proj(inp, tag):
+        w = helper.create_parameter(
+            ParamAttr(name=None if name is None else name + "." + tag,
+                      initializer=XavierInitializer(),
+                      sharding=(None, "mp")),
+            shape=[inp.shape[-1], d_model], dtype=dtype)
+        out = helper.create_variable_for_type_inference(
+            dtype=dtype, shape=tuple(inp.shape[:-1]) + (d_model,))
+        helper.append_op("matmul", {"X": inp, "Y": w}, {"Out": out}, {})
+        return out
+
+    q = proj(x, "q")
+    k = proj(x, "k")
+    v = proj(x, "v")
+    new_k = kv_cache_write_chunk(cache_k, k, pos, name=helper.name + "_kw")
+    new_v = kv_cache_write_chunk(cache_v, v, pos, name=helper.name + "_vw")
+    ctx = helper.create_variable_for_type_inference(
+        dtype=dtype, shape=tuple(x.shape[:-1]) + (d_model,))
+    helper.append_op("cached_attention_chunk",
                      {"Q": q, "CacheK": new_k, "CacheV": new_v, "Pos": pos},
                      {"Out": ctx}, {"num_heads": n_head})
     wo = helper.create_parameter(

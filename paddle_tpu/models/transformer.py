@@ -18,7 +18,8 @@ from ..core.param_attr import ParamAttr
 from .common import FeedSpec, ModelSpec
 
 __all__ = ["transformer_base", "transformer_flops_per_token",
-           "transformer_lm", "transformer_lm_step", "lm_step_config"]
+           "transformer_lm", "transformer_lm_step", "transformer_lm_chunk",
+           "lm_step_config"]
 
 
 def _ffn(x, d_model, d_ff, name, moe_experts=0, moe_k=2, aux_losses=None):
@@ -286,6 +287,69 @@ def transformer_lm_step(vocab=4000, d_model=64, d_ff=128, n_head=4,
                    "logits_fetch": logits.name, "cache_feeds": cache_feeds,
                    "vocab": vocab, "ctx_cap": ctx_cap}
     return fetch_vars, decode_spec
+
+
+def transformer_lm_chunk(vocab=4000, d_model=64, d_ff=128, n_head=4,
+                         n_layer=2, ctx_cap=64, pos_cap=512):
+    """KV-cached K-token chunk program — the third member of the
+    weight-sharing family (:func:`transformer_lm` /
+    :func:`transformer_lm_step` / this). One dispatch ingests K tokens
+    per slot row: chunked prefill (long prompts stop paying
+    step-per-token TTFT) and speculative verification (score k draft
+    tokens in one pass) are the same executable.
+
+    Feeds: ``tok_chunk`` [B, K] int64 (K declared -1: the chunk length
+    is a prefill-ladder bucket choice, not a program constant — one
+    executable per (batch rung, ctx rung, chunk rung)), ``chunk_pos``
+    [B, K] int32 (each token's own write index; the scheduler pads a
+    partial chunk lane with the cache capacity so its writes drop and
+    its logits are ignored), and the same per-layer ``cache_k_i`` /
+    ``cache_v_i`` [B, -1, d_model] carried caches as the step program.
+    Fetches: per-position ``logits`` [B, K, vocab] (the speculative
+    verifier's accept signal; plain prefill ignores them) then the
+    updated caches.
+
+    Returns ``(fetch_vars, chunk_spec)`` — the spec mirrors a decode
+    spec (same ``cache_feeds`` feed names, so the batcher's carried
+    cache dict feeds both programs)."""
+    assert ctx_cap <= pos_cap, "ctx_cap exceeds the shared pos table"
+    tok = layers.data("tok_chunk", shape=[-1], dtype="int64")
+    cpos = layers.data("chunk_pos", shape=[-1], dtype="int32")
+    cache_in = []
+    for i in range(n_layer):
+        cache_in.append(
+            (layers.data("cache_k_%d" % i, shape=[-1, d_model]),
+             layers.data("cache_v_%d" % i, shape=[-1, d_model])))
+    x = _lm_embed(tok, cpos, vocab, pos_cap, d_model)
+    cache_out = []
+    for i in range(n_layer):
+        nm = "lm%d" % i
+        ck, cv = cache_in[i]
+        a, nk, nv = layers.cached_multi_head_attention_chunk(
+            _named_ln(x, nm + "_attn_ln", 2), ck, cv, cpos,
+            d_model=d_model, n_head=n_head, name=nm + "_attn")
+        cache_out.append((nk, nv))
+        x = layers.elementwise_add(x, a)
+        f = _lm_ffn(_named_ln(x, nm + "_ffn_ln", 2), d_ff, d_model, nm, 2)
+        x = layers.elementwise_add(x, f)
+    x = _named_ln(x, "lm_ln", 2)
+    logits = layers.fc(x, size=vocab, num_flatten_dims=2,
+                       param_attr=ParamAttr(name="lm_out.w",
+                                            sharding=(None, "mp")),
+                       bias_attr=False, name="lm_out")
+    fetch_vars = [logits]
+    cache_feeds = []
+    for i, (nk, nv) in enumerate(cache_out):
+        fetch_vars += [nk, nv]
+        cache_feeds += [
+            {"feed": "cache_k_%d" % i, "fetch": nk.name,
+             "tail": [d_model], "dtype": "float32"},
+            {"feed": "cache_v_%d" % i, "fetch": nv.name,
+             "tail": [d_model], "dtype": "float32"}]
+    chunk_spec = {"token_feed": "tok_chunk", "pos_feed": "chunk_pos",
+                  "logits_fetch": logits.name, "cache_feeds": cache_feeds,
+                  "vocab": vocab, "ctx_cap": ctx_cap}
+    return fetch_vars, chunk_spec
 
 
 def transformer_flops_per_token(src_vocab, trg_vocab, seq_len, d_model, d_ff,

@@ -31,9 +31,11 @@ from .batcher import DynamicBatcher, Request  # noqa: F401
 from .buckets import (BucketError, bucket_for, pad_to_bucket,  # noqa: F401
                       pow2_ladder, unpad_fetch)
 from .decode_batcher import (DecodeBatcher, DecodeRequest,  # noqa: F401
+                             DraftLM, default_prefill_ladder,
                              load_decode_spec, save_decode_spec)
 from .engine import EngineShutdownError, ServingEngine  # noqa: F401
 from .metrics import ServingMetrics  # noqa: F401
+from .prefix_cache import PrefixCache, PrefixEntry, PrefixMatch  # noqa: F401
 from .router import (Router, RouterClient, RouterShutdownError,  # noqa: F401
                      WorkerFailedError)
 
@@ -41,6 +43,8 @@ __all__ = ["ServingEngine", "EngineShutdownError", "DynamicBatcher",
            "Request", "ServingMetrics", "AdmissionController",
            "ServerOverloadedError", "DeadlineExceededError", "BucketError",
            "pow2_ladder", "bucket_for", "pad_to_bucket", "unpad_fetch",
-           "DecodeBatcher", "DecodeRequest", "save_decode_spec",
-           "load_decode_spec", "Router", "RouterClient",
-           "WorkerFailedError", "RouterShutdownError"]
+           "DecodeBatcher", "DecodeRequest", "DraftLM",
+           "default_prefill_ladder", "PrefixCache", "PrefixEntry",
+           "PrefixMatch", "save_decode_spec", "load_decode_spec",
+           "Router", "RouterClient", "WorkerFailedError",
+           "RouterShutdownError"]

@@ -22,8 +22,44 @@ design the reference, whose serving story ends at
     table compacts/grows and caches are copied row-wise into the new
     geometry (rare, host-side, O(B*C*D));
   * prompt tokens are ingested through the same step function (one forced
-    token per step) — no separate prefill executable, so the compile
-    cache bound holds and a long prompt shares steps with everyone else.
+    token per step) — by default no separate prefill executable, so the
+    compile cache bound holds and a long prompt shares steps with
+    everyone else.
+
+Three optional fast paths ride on top (ISSUE 20), all off unless
+configured:
+
+  * **prefix cache** (``prefix_cache=``): a hash-trie over token-id
+    prefixes (``prefix_cache.PrefixCache``) maps shared prompt prefixes
+    to the KV rows the slot table already computed for them; ``submit``
+    matches the longest cached prefix, admission CLONES the rows into
+    the new slot (one device-side copy) and the slot starts at
+    ``pos = prefix_len`` — a request whose prefix is cached skips that
+    many step dispatches of TTFT. Entries are harvested when a prompt
+    finishes ingesting, LRU-evicted under byte/entry budgets, and
+    ref-counted against pending admissions; since live slots hold
+    CLONES, eviction can never corrupt an in-flight request.
+  * **chunked prefill** (``prefill=``): a K-token chunk program
+    (``transformer_lm_chunk``) ingests K prompt tokens per dispatch on
+    its own pow2 prefill ladder, interleaved chunk-by-chunk with decode
+    steps (when some live rows aren't covered by a chunk, the scheduler
+    alternates chunk/step ticks) so a long prompt neither pays
+    step-per-token TTFT nor stalls its co-riders. The compile cache
+    gains one executable per (batch rung, ctx rung, prefill rung) —
+    proved by ``analysis.resources.decode_cache_verdict`` via
+    :meth:`DecodeBatcher.compile_cache_bound`.
+  * **speculative decode** (``speculative=``): a small draft LM proposes
+    k-1 tokens per generating row; ONE pass of the chunk program scores
+    all k positions (the weight-sharing family makes the verifier free)
+    and each row accepts greedily — a draft token is emitted only while
+    it equals the target model's own argmax at that position, then the
+    verifier's next argmax is emitted as the bonus token. Rejected
+    drafts' cache writes are simply rewound (rows past a slot's fill
+    level are unreachable by the attention mask — the same property
+    slot recycling rests on). Greedy accept therefore emits exactly the
+    target model's greedy chain: output is identical to plain decode
+    (pinned bitwise on CPU by ``tests/test_serving.py``), regardless of
+    how bad the draft is — draft quality only moves throughput.
 
 **Exact-parity guarantee.** Every op in a step program is strictly
 per-row (``cached_attention`` masks each row to its own fill level;
@@ -53,9 +89,11 @@ from .admission import AdmissionController, DeadlineExceededError
 from .buckets import bucket_for, pow2_ladder
 from .engine import EngineShutdownError
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 
-__all__ = ["DecodeBatcher", "DecodeRequest", "save_decode_spec",
-           "load_decode_spec", "default_ctx_ladder"]
+__all__ = ["DecodeBatcher", "DecodeRequest", "DraftLM", "save_decode_spec",
+           "load_decode_spec", "default_ctx_ladder",
+           "default_prefill_ladder"]
 
 DECODE_SPEC_FILE = "decode_spec.json"
 
@@ -69,6 +107,69 @@ def default_ctx_ladder(spec):
     ladder the batcher actually compiles."""
     cap = int(spec.get("ctx_cap", 256) or 256)
     return tuple(r for r in pow2_ladder(cap) if r >= 16) or (cap,)
+
+
+def default_prefill_ladder(spec):
+    """The chunk-length rung ladder a prefill/verify chunk program gets
+    when the caller passes none: pow2 rungs from 4 up to half the cache
+    capacity (a chunk near the full capacity would serve exactly one
+    prompt shape — not worth an executable). Shared by
+    ``DecodeBatcher.__init__`` and the engine's build-time verdict, same
+    single-derivation rule as :func:`default_ctx_ladder`."""
+    cap = int(spec.get("ctx_cap", 256) or 256)
+    top = min(cap, max(4, cap // 2))
+    return tuple(r for r in pow2_ladder(top) if r >= 4) or (min(4, cap),)
+
+
+class DraftLM:
+    """Greedy draft proposer over a small FULL ``transformer_lm``
+    program: no KV cache of its own — each draft token is one pass of
+    the full causal program over the row's (window of) token history,
+    taking the argmax at the last real position. Deliberately simple:
+    the speculative accept rule guarantees output parity with plain
+    decode for ANY proposer, so the draft model only has to be cheap
+    and usually-right, not exact.
+
+    ``predictor``: ``run``/``fetch_names`` over a full-program build
+    (``transformer_lm``; fetch the ``logits`` extra). ``seq_len``: the
+    program's sequence length — longer histories are drafted from their
+    last ``seq_len`` tokens (a sliding window; quality detail only).
+    ``ladder``: pow2 batch rungs the draft batch is padded to, bounding
+    the draft program's own compile cache."""
+
+    def __init__(self, predictor, logits_fetch, seq_len, ids_feed="ids",
+                 lbl_feed="lbl", ladder=(1, 2, 4, 8)):
+        self._pred = predictor
+        self._logits_idx = list(predictor.fetch_names).index(logits_fetch)
+        self.seq_len = int(seq_len)
+        self.ids_feed = ids_feed
+        self.lbl_feed = lbl_feed
+        self.ladder = tuple(sorted(set(ladder)))
+
+    def propose(self, histories, n):
+        """``n`` greedy continuations for each token history. Returns a
+        list of n-token lists, one per history."""
+        hists = [list(h) for h in histories]
+        out = [[] for _ in histories]
+        for _ in range(int(n)):
+            b = bucket_for(len(hists), self.ladder) \
+                if len(hists) <= max(self.ladder) else len(hists)
+            ids = np.zeros((b, self.seq_len), np.int64)
+            lens = []
+            for j, h in enumerate(hists):
+                t = h[-self.seq_len:]
+                ids[j, :len(t)] = t
+                lens.append(len(t))
+            feed = {self.ids_feed: ids}
+            if self.lbl_feed:
+                feed[self.lbl_feed] = ids
+            outs = self._pred.run(feed, return_numpy=False)
+            logits = np.asarray(outs[self._logits_idx])
+            for j, fill in enumerate(lens):
+                t = int(np.argmax(logits[j, fill - 1]))
+                out[j].append(t)
+                hists[j].append(t)
+        return out
 
 
 def save_decode_spec(dirname, spec):
@@ -99,16 +200,19 @@ class DecodeRequest:
     and the admission timestamps the TTFT/TPOT metrics read."""
 
     __slots__ = ("prompt", "max_new", "eos_id", "future", "enqueue_t",
-                 "deadline", "n_ctx")
+                 "deadline", "n_ctx", "prefix")
 
     def __init__(self, prompt, max_new, eos_id, future, enqueue_t,
-                 deadline=None):
+                 deadline=None, prefix=None):
         self.prompt = [int(t) for t in prompt]
         self.max_new = int(max_new)
         self.eos_id = eos_id
         self.future = future
         self.enqueue_t = enqueue_t
         self.deadline = deadline
+        # pinned PrefixEntry matched at submit (cloned + released at
+        # admission), or None
+        self.prefix = prefix
         # cache capacity this request needs: every prompt token is written
         # once, then at most max_new-1 generated tokens are fed back (the
         # last sampled token never re-enters the cache), so the highest
@@ -123,15 +227,22 @@ class DecodeRequest:
 class _Slot:
     """One occupied slot-table row."""
 
-    __slots__ = ("req", "pos", "k", "out", "next_token", "first_tok_t")
+    __slots__ = ("req", "pos", "k", "out", "next_token", "first_tok_t",
+                 "harvested")
 
     def __init__(self, req):
         self.req = req
-        self.pos = 0            # next cache index == tokens ingested
-        self.k = 1              # prompt cursor: prompt[0] feeds first
+        # a matched prefix starts the slot past its cloned rows: the
+        # first m tokens are already in the cache, so ingestion resumes
+        # at prompt[m] (m <= len(prompt)-1 — the last token always feeds
+        # through the model to produce first-generation logits)
+        m = req.prefix.length if req.prefix is not None else 0
+        self.pos = m            # next cache index == tokens ingested
+        self.k = m + 1          # prompt cursor: prompt[m] feeds first
         self.out = []           # generated ids
-        self.next_token = req.prompt[0]
+        self.next_token = req.prompt[m]
         self.first_tok_t = None
+        self.harvested = False  # this prompt's rows offered to the cache
 
     @property
     def forcing(self):
@@ -159,7 +270,8 @@ class DecodeBatcher:
     def __init__(self, predictor, spec, ladder=None, ctx_ladder=None,
                  max_batch_size=8, max_queue_depth=256,
                  default_timeout_s=None, default_max_new_tokens=64,
-                 eos_id=None, clock=None, metrics=None, start=True):
+                 eos_id=None, clock=None, metrics=None, start=True,
+                 prefix_cache=None, prefill=None, speculative=None):
         self._predictor = predictor
         self._spec = dict(spec)
         self._tok_feed = self._spec["token_feed"]
@@ -190,6 +302,71 @@ class DecodeBatcher:
             self.metrics_ = ServingMetrics()
             self.metrics_.bind_gauges(lambda: len(self._pending),
                                       lambda: self._admission.in_flight)
+
+        # -- prefix cache (optional): True / kwargs dict builds an owned
+        # instance; a PrefixCache instance is shared (the engine's)
+        self.prefix_cache = None
+        # NOT a truthiness test: an EMPTY PrefixCache is len()==0/falsy
+        if prefix_cache is not None and prefix_cache is not False:
+            if isinstance(prefix_cache, PrefixCache):
+                self.prefix_cache = prefix_cache
+            else:
+                kw = (dict(prefix_cache) if isinstance(prefix_cache, dict)
+                      else {})
+                kw.setdefault("metrics", self.metrics_)
+                self.prefix_cache = PrefixCache(**kw)
+                if metrics is None:
+                    self.metrics_.bind_prefix_bytes(
+                        lambda: self.prefix_cache.nbytes)
+
+        # -- chunked prefill / speculative verify (optional): the chunk
+        # program must share the step program's cache feed names so the
+        # carried cache dict feeds both
+        self._prefill = None
+        self.prefill_ladder = ()
+        if prefill is not None:
+            p = dict(prefill)
+            cpred = p["predictor"]
+            cspec = dict(p["spec"])
+            cfetch = list(cpred.fetch_names)
+            step_feeds = {cf["feed"] for cf in self._spec["cache_feeds"]}
+            cmap = []
+            for cf in cspec["cache_feeds"]:
+                if cf["feed"] not in step_feeds:
+                    raise ValueError(
+                        "chunk cache feed %r has no step-program "
+                        "counterpart — the chunk program must share the "
+                        "step's cache feed names" % cf["feed"])
+                cmap.append((cf["feed"], cfetch.index(cf["fetch"])))
+            if len(cmap) != len(step_feeds):
+                raise ValueError(
+                    "chunk program covers %d of the step program's %d "
+                    "cache feeds" % (len(cmap), len(step_feeds)))
+            pl = p.get("ladder")
+            if pl is None:
+                pl = default_prefill_ladder(self._spec)
+            self.prefill_ladder = tuple(sorted(set(int(k) for k in pl)))
+            self._prefill = {
+                "pred": cpred, "tok": cspec["token_feed"],
+                "pos": cspec["pos_feed"],
+                "logits_idx": cfetch.index(cspec["logits_fetch"]),
+                "cache_map": cmap}
+        self._alt_chunk = False
+
+        # -- speculative decode (optional, rides the chunk program)
+        self._draft = None
+        self._spec_k = 0
+        if speculative is not None:
+            if self._prefill is None:
+                raise ValueError("speculative decode needs the chunk "
+                                 "program (pass prefill= as well)")
+            s = dict(speculative)
+            self._draft = s["draft"]
+            k = int(s.get("k", 4))
+            if k < 2:
+                raise ValueError("speculative k must be >= 2 "
+                                 "(k-1 drafts + the committed token)")
+            self._spec_k = k
 
         self._pending = deque()
         self._slots = []          # list[_Slot | None], len == bucket_batch
@@ -234,11 +411,18 @@ class DecodeBatcher:
         now = self._clock()
         deadline = now + timeout_s if timeout_s is not None else None
         self._admission.acquire(1)
+        prefix = None
+        if self.prefix_cache is not None and prompt.size > 1:
+            # match capped at len-1: the last prompt token must feed
+            # through the step to produce first-generation logits
+            prefix = self.prefix_cache.lookup(prompt,
+                                              limit=int(prompt.size) - 1)
         req = DecodeRequest(prompt, max_new, eos, Future(), now,
-                            deadline=deadline)
+                            deadline=deadline, prefix=prefix)
         with self._cv:
             if self._closed:
                 self._admission.release(1)
+                self._release_prefix(req)
                 raise RuntimeError("DecodeBatcher is shut down")
             self._pending.append(req)
             self._cv.notify_all()
@@ -258,24 +442,31 @@ class DecodeBatcher:
         return self.metrics_.report()
 
     def compiled_shape_counts(self):
-        """Distinct (bucket_batch, bucket_ctx) geometries dispatched —
-        bounded at ``len(ladder) * len(ctx_ladder)`` by construction."""
+        """Distinct step ``(bucket_batch, bucket_ctx)`` and chunk
+        ``(bucket_batch, bucket_ctx, chunk_rung)`` geometries dispatched
+        — bounded at :meth:`compile_cache_bound` by construction."""
         return [len(self.seen_signatures)]
 
     def compile_cache_bound(self):
         """The PROVED executable-count bound (ISSUE 15): the static
         compile-cache verdict from the decode spec — dispatched
-        geometries (:meth:`compiled_shape_counts`) can never exceed it."""
+        geometries (:meth:`compiled_shape_counts`) can never exceed it.
+        With a chunk program attached the bound covers the prefill
+        ladder too: ``len(ladder) * len(ctx_ladder) *
+        (1 + len(prefill_ladder))``."""
         from ..analysis.resources import decode_cache_verdict
 
-        bound, _result = decode_cache_verdict(self._spec, self.ladder,
-                                              self.ctx_ladder)
+        bound, _result = decode_cache_verdict(
+            self._spec, self.ladder, self.ctx_ladder,
+            prefill_ladder=self.prefill_ladder)
         return bound
 
     def warmup(self):
-        """Pre-compile every (batch rung, ctx rung) geometry with a
-        zero-token synthetic step, so live traffic never compiles.
-        Returns the number of geometries warmed."""
+        """Pre-compile every (batch rung, ctx rung) step geometry — and,
+        when a chunk program rides along, every (batch, ctx, chunk rung)
+        chunk geometry — with a zero-token synthetic dispatch, so live
+        traffic never compiles. Returns the number of geometries
+        warmed."""
         warmed = 0
         for b in self.ladder:
             for c in self.ctx_ladder:
@@ -283,6 +474,13 @@ class DecodeBatcher:
                 self._predictor.run(feed, return_numpy=False)
                 self.seen_signatures.add((b, c))
                 warmed += 1
+                if self._prefill is not None:
+                    for k in self.prefill_ladder:
+                        cfeed = self._synth_chunk_feed(b, c, k)
+                        self._prefill["pred"].run(cfeed,
+                                                  return_numpy=False)
+                        self.seen_signatures.add((b, c, k))
+                        warmed += 1
         return warmed
 
     def drive(self, max_steps=None):
@@ -298,7 +496,7 @@ class DecodeBatcher:
             self._admit()
             if not any(s is not None for s in self._slots):
                 break
-            self._step_once()
+            self._tick()
             steps += 1
         return steps
 
@@ -321,7 +519,7 @@ class DecodeBatcher:
                     self._admit()
                     if not any(s is not None for s in self._slots):
                         break
-                    self._step_once()
+                    self._tick()
             else:
                 self._abort_live()
         self._fail_pending()
@@ -348,7 +546,7 @@ class DecodeBatcher:
             try:
                 self._admit()
                 if any(s is not None for s in self._slots):
-                    self._step_once()
+                    self._tick()
                 elif self._closed:
                     break
             except BaseException as e:  # noqa: BLE001 — fail loudly, once
@@ -384,7 +582,15 @@ class DecodeBatcher:
                 self.metrics_.observe_failed()
                 self._slots[i] = None
 
+    def _release_prefix(self, req):
+        """Drop a request's pinned prefix entry (cloned, failed, or
+        expired — the pin must never outlive the request)."""
+        if self.prefix_cache is not None and req.prefix is not None:
+            self.prefix_cache.release(req.prefix.entry)
+            req.prefix = None
+
     def _resolve_exc(self, req, exc):
+        self._release_prefix(req)
         try:
             req.future.set_exception(exc)
         except Exception:
@@ -442,6 +648,7 @@ class DecodeBatcher:
                 free = [i for i, s in enumerate(self._slots) if s is None]
                 for req, i in zip(admitting, free):
                     self._slots[i] = _Slot(req)
+                    self._install_prefix(i, req)
             return
         old_c = self._bucket[1]
         live = [(i, s) for i, s in enumerate(self._slots) if s is not None]
@@ -460,10 +667,232 @@ class DecodeBatcher:
             self._caches[feed] = new
         self._slots = new_slots
         self._bucket = (new_b, new_c)
+        for j, req in enumerate(admitting, start=len(live)):
+            self._install_prefix(j, req)
+
+    def _install_prefix(self, i, req):
+        """Clone a matched prefix's leading rows into slot row ``i`` and
+        release the pin. CLONE, never alias: after this the slot owns
+        its rows, so evicting the entry can never corrupt the slot."""
+        match = req.prefix
+        if match is None:
+            return
+        m = match.length
+        for feed, _idx, _tail, _dtype in self._cache_feeds:
+            rows = match.entry.rows.get(feed)
+            if rows is None:
+                continue
+            rows = np.asarray(rows)[:m]
+            cache = self._caches[feed]
+            if isinstance(cache, np.ndarray):
+                cache[i, :m] = rows
+            else:  # device-resident jax array: one device-side copy
+                self._caches[feed] = cache.at[i, :m].set(rows)
+        self._release_prefix(req)
 
     def _synth_feed(self, b, c):
         feed = {self._tok_feed: np.zeros((b,), np.int64),
                 self._pos_feed: np.zeros((b,), np.int32)}
+        for name, _idx, tail, dtype in self._cache_feeds:
+            feed[name] = np.zeros((b, c) + tail, dtype)
+        return feed
+
+    def _tick(self):
+        """One scheduler quantum: a chunk dispatch (prefill and/or
+        speculative verify) when the chunk program has work, else one
+        decode step. When some live rows can't ride the chunk (they are
+        generating and speculation is off), chunk and step ticks
+        ALTERNATE so a long prompt is ingested chunk-by-chunk without
+        stalling its co-riders."""
+        if self._prefill is None:
+            self._step_once()
+            return
+        plan = self._chunk_plan()
+        if plan is None:
+            self._alt_chunk = False
+            self._step_once()
+            return
+        rows, has_uncovered, verifying = plan
+        if has_uncovered and self._alt_chunk:
+            self._alt_chunk = False
+            self._step_once()
+            return
+        self._alt_chunk = True
+        with trace.span("spec.verify" if verifying
+                        else "prefill.chunk") as sp:
+            self._chunk_once(rows, sp)
+
+    def _chunk_plan(self):
+        """This tick's chunk rows as ``(rows, has_uncovered, verifying)``
+        — or None when no live row wants the chunk program. Each row is
+        ``(i, slot, tokens, n_forced)``: lane j of the chunk feeds
+        ``tokens[j]`` at cache index ``slot.pos + j``; the first
+        ``n_forced`` tokens are committed (prompt or already-emitted),
+        the rest are speculative drafts judged against the chunk's own
+        logits."""
+        spec = self._spec_k > 0
+        top = self.prefill_ladder[-1]
+        ingest = []     # (i, slot, want) — rows with prompt left
+        verify = []     # (i, slot) — generating rows (spec mode)
+        uncovered = 0
+        for i, slot in enumerate(self._slots):
+            if slot is None:
+                continue
+            pending = len(slot.req.prompt) - slot.pos
+            if pending >= 2 or (spec and pending == 1):
+                # plain prefill leaves the LAST prompt token for the
+                # step program (sampling stays on the step path —
+                # bitwise parity with plain decode); spec mode ingests
+                # through it and samples from the chunk's own logits
+                want = pending if spec else pending - 1
+                ingest.append((i, slot, min(want, top)))
+            elif spec:
+                verify.append((i, slot))
+            else:
+                uncovered += 1
+        if not ingest and not verify:
+            return None
+        rows = []
+        for i, slot, want in ingest:
+            toks = slot.req.prompt[slot.pos:slot.pos + want]
+            rows.append((i, slot, toks, len(toks)))
+        if verify:
+            needs = []
+            for i, slot in verify:
+                real = min(self._spec_k,
+                           slot.req.max_new - len(slot.out), top)
+                needs.append(max(0, real - 1))
+            drafts = self._draft_for([s for _i, s in verify], max(needs))
+            for (i, slot), d, n in zip(verify, drafts, needs):
+                rows.append((i, slot, [slot.next_token] + d[:n], 1))
+        return rows, uncovered > 0, bool(verify)
+
+    def _draft_for(self, slots, n):
+        """``n`` draft continuations per generating slot, from the small
+        draft LM over each slot's committed history. Any proposal is
+        SAFE — the accept rule only ever emits the target model's own
+        greedy chain — so draft failures degrade to 1-token progress
+        rather than failing the tick."""
+        if n <= 0:
+            return [[] for _ in slots]
+        hists = [s.req.prompt + s.out for s in slots]
+        try:
+            return self._draft.propose(hists, n)
+        except Exception:
+            return [[] for _ in slots]
+
+    def _chunk_once(self, rows, sp):
+        """Dispatch one chunk: K-token lanes per covered row, pad lanes
+        carry the pad sentinel ``pos == bucket_ctx`` (their cache writes
+        drop via the op's out-of-range mode and their logits are
+        ignored). Commits forced tokens, then emits each verify row's
+        greedy chain: drafts are accepted while they equal the chunk's
+        own argmax, the first disagreement is replaced by the argmax
+        itself (always >= 1 token of progress), and rejected lanes are
+        REWOUND by pointer arithmetic — rows past a slot's fill level
+        are unreachable by the attention mask, the same property slot
+        recycling rests on."""
+        pf = self._prefill
+        b, c = self._bucket
+        k = bucket_for(max(len(t) for _i, _s, t, _f in rows),
+                       self.prefill_ladder)
+        tok = np.zeros((b, k), np.int64)
+        cpos = np.full((b, k), c, np.int32)
+        for i, slot, tokens, _f in rows:
+            n = len(tokens)
+            tok[i, :n] = tokens
+            cpos[i, :n] = np.arange(slot.pos, slot.pos + n, dtype=np.int32)
+        feed = dict(self._caches)
+        feed[pf["tok"]] = tok
+        feed[pf["pos"]] = cpos
+        outs = pf["pred"].run(feed, return_numpy=False)
+        self.seen_signatures.add((b, c, k))
+        for name, idx in pf["cache_map"]:
+            self._caches[name] = outs[idx]
+        greedy = None
+        now = self._clock()
+        live = sum(1 for s in self._slots if s is not None)
+        generated = 0
+        accepted = rejected = 0
+        chunk_rows = 0
+        chunk_toks = 0
+        for i, slot, tokens, n_forced in rows:
+            real = len(tokens)
+            base = slot.pos
+            L = len(slot.req.prompt)
+            ingested = max(0, min(L - base, n_forced))
+            if ingested:
+                chunk_rows += 1
+                chunk_toks += ingested
+            if n_forced == real and base + real < L:
+                # pure prompt ingestion, prompt not finished
+                slot.pos = base + real
+                slot.k = slot.pos + 1
+                slot.next_token = slot.req.prompt[slot.pos]
+                continue
+            # the chunk covered through the last prompt token (spec
+            # prefill) or this is a verify row: emit the greedy chain
+            if greedy is None:
+                greedy = np.argmax(
+                    np.asarray(outs[pf["logits_idx"]]), axis=-1)
+            req = slot.req
+            j = n_forced - 1
+            emitted = [int(greedy[i, j])]
+            while (j + 1 < real
+                   and len(slot.out) + len(emitted) < req.max_new
+                   and (req.eos_id is None or emitted[-1] != req.eos_id)
+                   and tokens[j + 1] == emitted[-1]):
+                j += 1
+                emitted.append(int(greedy[i, j]))
+            if n_forced < real:
+                accepted += j - (n_forced - 1)
+                rejected += (real - n_forced) - (j - (n_forced - 1))
+            slot.pos = base + j + 1
+            for t in emitted:
+                slot.out.append(t)
+                generated += 1
+                if slot.first_tok_t is None:
+                    slot.first_tok_t = now
+                    self.metrics_.observe_ttft(now - req.enqueue_t)
+            if not slot.harvested and slot.pos >= L:
+                self._maybe_harvest(i, slot)
+            done = (len(slot.out) >= req.max_new
+                    or (req.eos_id is not None
+                        and slot.out[-1] == req.eos_id))
+            if done:
+                self._retire(i, slot, now)
+            else:
+                slot.next_token = slot.out[-1]
+        if chunk_rows:
+            self.metrics_.observe_prefill_chunk(chunk_rows, chunk_toks)
+        if accepted or rejected:
+            self.metrics_.observe_spec(accepted, rejected)
+        self.metrics_.observe_decode_step(live, b, generated)
+        if sp:
+            sp.set(live=live, bucket=b, ctx=c, chunk=k,
+                   generated=generated, accepted=accepted,
+                   rejected=rejected)
+
+    def _maybe_harvest(self, i, slot):
+        """First full ingestion of this prompt: offer its KV rows [0:L]
+        to the prefix cache (one host copy per request, once; the cache
+        keeps them only if the exact prompt isn't already an entry)."""
+        slot.harvested = True
+        if self.prefix_cache is None:
+            return
+        key = slot.req.prompt
+        if len(key) < 2 or key in self.prefix_cache:
+            return
+        rows = {}
+        for feed, _idx, _tail, _dtype in self._cache_feeds:
+            rows[feed] = np.array(np.asarray(self._caches[feed])[i,
+                                                                 :len(key)])
+        self.prefix_cache.insert(key, rows)
+
+    def _synth_chunk_feed(self, b, c, k):
+        pf = self._prefill
+        feed = {pf["tok"]: np.zeros((b, k), np.int64),
+                pf["pos"]: np.full((b, k), c, np.int32)}
         for name, _idx, tail, dtype in self._cache_feeds:
             feed[name] = np.zeros((b, c) + tail, dtype)
         return feed
@@ -504,6 +933,8 @@ class DecodeBatcher:
                 slot.next_token = slot.req.prompt[slot.k]
                 slot.k += 1
                 continue
+            if not slot.harvested and slot.pos >= len(slot.req.prompt):
+                self._maybe_harvest(i, slot)
             nxt = int(np.argmax(logits[i]))
             generated += 1
             slot.out.append(nxt)

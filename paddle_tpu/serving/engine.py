@@ -76,7 +76,8 @@ class ServingEngine:
                  cross_replica_retry=True, shed_on_overload=True,
                  supervisor_interval_s=0.05, placement="single", mp=1,
                  devices=None, decode=None, default_max_new_tokens=64,
-                 eos_id=None):
+                 eos_id=None, prefix_cache=None, decode_prefill=None,
+                 decode_speculative=None):
         """``model``: a model directory / ``AnalysisConfig`` (loaded via
         ``Predictor``), or an already-constructed predictor exposing
         ``run``/``clone``/``feed_names`` (``Predictor``,
@@ -103,6 +104,19 @@ class ServingEngine:
         ``submit(prompt_ids, max_new_tokens=..., eos_id=...)`` and the
         future resolves to the generated ids. ``ladder`` bounds the slot
         table, ``seq_ladder`` the KV-cache capacity rungs.
+
+        Decode fast paths (ISSUE 20): ``prefix_cache=True`` (or a kwargs
+        dict / :class:`~.prefix_cache.PrefixCache`) shares ONE prefix-KV
+        cache across every replica, with the engine's metrics carrying
+        the hit/eviction/bytes counters. ``decode_prefill={"predictor":
+        chunk_pred, "spec": chunk_spec, "ladder": ...}`` attaches the
+        K-token chunk program (``transformer_lm_chunk``) — replica 0
+        uses the predictor as-is, later replicas ``clone()`` it — and
+        extends the build-time compile-cache verdict with the prefill
+        ladder. ``decode_speculative={"draft": DraftLM, "k": ...}``
+        turns on speculative decode (requires ``decode_prefill``); the
+        draft proposer is SHARED across replicas, so multi-replica
+        engines need a thread-safe draft predictor.
 
         Reliability knobs: ``max_replica_failures`` consecutive batch
         failures evict a replica and rebuild it from the parent
@@ -168,23 +182,46 @@ class ServingEngine:
         self._decoders = None
         if decode_spec is not None:
             from .decode_batcher import DecodeBatcher
+            from .prefix_cache import PrefixCache
 
             # build-time resource verification (ISSUE 15): prove the
             # compile-cache bound from the decode spec — dead ctx rungs
             # and an over-budget ladder product are construction-time
             # warnings, not a production surprise at warmup
-            self._verify_decode_build(decode_spec)
+            self._verify_decode_build(decode_spec, decode_prefill)
+            # one prefix cache for the whole fleet: any replica's
+            # harvest serves any replica's admission
+            self._prefix_cache = None
+            # NOT a truthiness test: an empty PrefixCache is len()==0
+            if prefix_cache is not None and prefix_cache is not False:
+                if isinstance(prefix_cache, PrefixCache):
+                    self._prefix_cache = prefix_cache
+                else:
+                    kw = (dict(prefix_cache)
+                          if isinstance(prefix_cache, dict) else {})
+                    kw.setdefault("metrics", self.metrics_)
+                    self._prefix_cache = PrefixCache(**kw)
+                pc = self._prefix_cache
+                self.metrics_.bind_prefix_bytes(lambda: pc.nbytes)
             self._decoders = []
             for i in range(num_replicas):
                 parent = parents[i % len(parents)]
                 pred = parent if i < len(parents) else parent.clone()
+                prefill = None
+                if decode_prefill is not None:
+                    prefill = dict(decode_prefill)
+                    if i > 0:
+                        prefill["predictor"] = \
+                            decode_prefill["predictor"].clone()
                 self._decoders.append(DecodeBatcher(
                     pred, decode_spec, ladder=self.ladder,
                     ctx_ladder=self.seq_ladder,
                     max_queue_depth=max_queue_depth,
                     default_timeout_s=default_timeout_s,
                     default_max_new_tokens=default_max_new_tokens,
-                    eos_id=eos_id, clock=clock, metrics=self.metrics_))
+                    eos_id=eos_id, clock=clock, metrics=self.metrics_,
+                    prefix_cache=self._prefix_cache, prefill=prefill,
+                    speculative=decode_speculative))
             # aggregate gauges over every replica's queue (each batcher
             # got the shared metrics and deliberately did NOT bind its
             # own — a per-replica bind would report only the last one)
@@ -221,22 +258,30 @@ class ServingEngine:
                 name="paddle-tpu-serve-supervisor", daemon=True)
             self._supervisor.start()
 
-    def _verify_decode_build(self, decode_spec):
+    def _verify_decode_build(self, decode_spec, decode_prefill=None):
         """Static compile-cache verdict for the decode tier
         (``analysis.resources.decode_cache_verdict``): the scheduler's
         executable count is bounded by len(ladder) x len(valid ctx
-        rungs) — proved from the spec's cache capacity, checked against
-        the budget at CONSTRUCTION. Findings surface as warnings and the
-        result is kept on ``self.build_verification``; the proved bound
-        on ``self.compile_cache_bound``."""
+        rungs) — times (1 + len(prefill rungs)) when a chunk program
+        rides along — proved from the spec's cache capacity, checked
+        against the budget at CONSTRUCTION. Findings surface as warnings
+        and the result is kept on ``self.build_verification``; the
+        proved bound on ``self.compile_cache_bound``."""
         from ..analysis.resources import decode_cache_verdict
-        from .decode_batcher import default_ctx_ladder
+        from .decode_batcher import (default_ctx_ladder,
+                                     default_prefill_ladder)
 
         ctx_ladder = self.seq_ladder
         if ctx_ladder is None:
             ctx_ladder = default_ctx_ladder(decode_spec)
+        prefill_ladder = None
+        if decode_prefill is not None:
+            prefill_ladder = decode_prefill.get("ladder")
+            if prefill_ladder is None:
+                prefill_ladder = default_prefill_ladder(decode_spec)
         bound, result = decode_cache_verdict(decode_spec, self.ladder,
-                                             ctx_ladder)
+                                             ctx_ladder,
+                                             prefill_ladder=prefill_ladder)
         self.compile_cache_bound = bound
         self.build_verification = result
         for d in result.diagnostics:

@@ -51,6 +51,13 @@ _COUNTERS = (
     ("decode_tokens", "tokens sampled by the decode loop"),
     ("slot_live", "occupied slots summed over decode steps"),
     ("slot_total", "total slots summed over decode steps"),
+    ("prefix_hits", "admissions that cloned a cached KV prefix"),
+    ("prefix_tokens_reused", "prompt tokens skipped via prefix-cache hits"),
+    ("prefix_evictions", "prefix-cache entries evicted (LRU)"),
+    ("prefill_chunks", "chunked-prefill / speculative-verify dispatches"),
+    ("prefill_tokens", "prompt tokens ingested through chunk dispatches"),
+    ("spec_accepted", "speculative draft tokens accepted by the verifier"),
+    ("spec_rejected", "speculative draft tokens rejected by the verifier"),
 )
 
 _PREFIX = "paddle_tpu_serving_"
@@ -72,12 +79,17 @@ class ServingMetrics:
                                                    help=help_text)
         self._queue_depth_fn = lambda: 0
         self._in_flight_fn = lambda: 0
+        self._prefix_bytes_fn = lambda: 0
         self.registry.gauge(_PREFIX + "queue_depth",
                             help="examples queued, not yet in a batch",
                             fn=lambda: self._queue_depth_fn())
         self.registry.gauge(_PREFIX + "in_flight",
                             help="admitted examples not yet resolved",
                             fn=lambda: self._in_flight_fn())
+        self.registry.gauge(_PREFIX + "prefix_bytes",
+                            help="bytes of KV rows held by the prefix "
+                                 "cache",
+                            fn=lambda: self._prefix_bytes_fn())
         self.registry.histogram(_PREFIX + "latency_seconds", self.latency,
                                 help="request latency (sliding window)")
         self.registry.histogram(_PREFIX + "ttft_seconds", self.ttft,
@@ -89,6 +101,11 @@ class ServingMetrics:
     def bind_gauges(self, queue_depth_fn, in_flight_fn):
         self._queue_depth_fn = queue_depth_fn
         self._in_flight_fn = in_flight_fn
+
+    def bind_prefix_bytes(self, fn):
+        """The prefix cache's live byte count (one cache may back many
+        batchers, so the OWNER binds it, same as :meth:`bind_gauges`)."""
+        self._prefix_bytes_fn = fn
 
     # -- observation points -------------------------------------------------
     def observe_completed(self, latency_s):
@@ -158,6 +175,29 @@ class ServingMetrics:
         self._c["slot_live"].inc(live)
         self._c["slot_total"].inc(bucket)
 
+    def observe_prefix_hit(self, tokens_reused):
+        """One admission cloned a cached KV prefix instead of
+        re-prefilling ``tokens_reused`` prompt tokens step by step."""
+        self._c["prefix_hits"].inc()
+        self._c["prefix_tokens_reused"].inc(int(tokens_reused))
+
+    def observe_prefix_eviction(self, n=1):
+        self._c["prefix_evictions"].inc(n)
+
+    def observe_prefill_chunk(self, rows, tokens):
+        """One chunk dispatch (prefill and/or speculative verify):
+        ``rows`` slot rows participated, ``tokens`` prompt tokens were
+        ingested through it (verify lanes count under spec_*)."""
+        self._c["prefill_chunks"].inc()
+        self._c["prefill_tokens"].inc(int(tokens))
+
+    def observe_spec(self, accepted, rejected):
+        """One speculative verify outcome: ``accepted`` draft tokens
+        matched the target model's greedy choice, ``rejected`` did not
+        (the bonus token the verifier emits itself counts in neither)."""
+        self._c["spec_accepted"].inc(int(accepted))
+        self._c["spec_rejected"].inc(int(rejected))
+
     def observe_ttft(self, latency_s):
         """Admission -> first sampled token for one request."""
         self.ttft.add(latency_s)
@@ -211,6 +251,18 @@ class ServingMetrics:
             "decode_tokens": c["decode_tokens"],
             "slot_occupancy": (c["slot_live"] / c["slot_total"]
                                if c["slot_total"] else None),
+            "prefix_hits": c["prefix_hits"],
+            "prefix_tokens_reused": c["prefix_tokens_reused"],
+            "prefix_evictions": c["prefix_evictions"],
+            "prefix_bytes": self._prefix_bytes_fn(),
+            "prefill_chunks": c["prefill_chunks"],
+            "prefill_tokens": c["prefill_tokens"],
+            "spec_accepted": c["spec_accepted"],
+            "spec_rejected": c["spec_rejected"],
+            "spec_accept_rate": (
+                c["spec_accepted"]
+                / (c["spec_accepted"] + c["spec_rejected"])
+                if (c["spec_accepted"] + c["spec_rejected"]) else None),
         }
         lat = self.latency.percentiles((50, 95, 99))
         snap["latency_s"] = {k: lat[k] for k in ("p50", "p95", "p99")}
@@ -250,7 +302,11 @@ class ServingMetrics:
                     "in_flight", "batches", "avg_batch_size",
                     "batch_occupancy", "compile_cache_hits",
                     "compile_cache_misses", "compile_cache_hit_rate",
-                    "decode_steps", "decode_tokens", "slot_occupancy"):
+                    "decode_steps", "decode_tokens", "slot_occupancy",
+                    "prefix_hits", "prefix_tokens_reused",
+                    "prefix_evictions", "prefix_bytes", "prefill_chunks",
+                    "prefill_tokens", "spec_accepted", "spec_rejected",
+                    "spec_accept_rate"):
             lines.append("%-32s %14s" % (key, fmt(s[key])))
         for group in ("latency_s", "ttft_s", "tpot_s"):
             prefix = group[:-2]  # strip the _s unit suffix

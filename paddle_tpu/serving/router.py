@@ -957,12 +957,14 @@ class RouterClient:
         exc.kind = kind
         raise exc
 
-    def _infer(self, feed, deadline, key):
+    def _infer(self, feed, deadline, key, extra=None):
         header = {"type": "infer"}
         if key is not None:
             header["key"] = key
         if deadline is not None:
             header["deadline_s"] = deadline.remaining()
+        if extra:
+            header.update(extra)
         with trace.span("client.predict") as sp:
             # the root of the cross-process trace: inject THIS span's
             # context so every hop downstream stitches onto one trace id
@@ -976,23 +978,28 @@ class RouterClient:
         n = reply_header.get("n_out", 0)
         return [arrays["o%d" % i] for i in range(n)]
 
-    def predict(self, feed, timeout_s=None, key=None):
+    def predict(self, feed, timeout_s=None, key=None, **decode_kw):
         """Synchronous inference -> list of fetch arrays. Raises the
         same typed errors the in-process engine does
         (:class:`ServerOverloadedError`, :class:`DeadlineExceededError`)
-        plus :class:`WorkerFailedError` / :class:`RouterShutdownError`."""
+        plus :class:`WorkerFailedError` / :class:`RouterShutdownError`.
+
+        Extra keyword args (``max_new_tokens``, ``eos_id``) ride the
+        infer header verbatim — the router forwards unknown header
+        fields untouched, and decode workers read them per request."""
         t = timeout_s if timeout_s is not None else self.default_timeout_s
         deadline = None if t is None else Deadline(t, clock=self.clock)
-        return self._infer(feed, deadline, key)
+        return self._infer(feed, deadline, key, decode_kw or None)
 
-    def submit(self, feed, timeout_s=None, key=None):
+    def submit(self, feed, timeout_s=None, key=None, **decode_kw):
         """Async inference -> ``concurrent.futures.Future`` resolving to
         the fetch list (or raising the typed error)."""
         if self._closed:
             raise RouterShutdownError("client closed")
         t = timeout_s if timeout_s is not None else self.default_timeout_s
         deadline = None if t is None else Deadline(t, clock=self.clock)
-        return self._pool.submit(self._infer, feed, deadline, key)
+        return self._pool.submit(self._infer, feed, deadline, key,
+                                 decode_kw or None)
 
     def metrics(self):
         """Router-side metrics snapshot + per-worker health states."""

@@ -75,7 +75,11 @@ READY_PREFIX = "PADDLE_TPU_WORKER_READY "
 def build_model(spec):
     """Resolve ``--model``: a saved-model directory passes through (the
     engine loads it); ``builtin:<name>`` builds a small in-process
-    program + scope and returns a ``ProgramPredictor`` over it."""
+    program + scope and returns a ``ProgramPredictor`` over it.
+    ``builtin:lm_decode`` builds the KV-cache decode step program (plus
+    its chunk sibling for chunked prefill) and tags the predictor with
+    ``_decode_spec``/``_decode_prefill`` so ``main()`` can stand up a
+    continuous-batching decode engine behind the same socket."""
     if not spec.startswith("builtin:"):
         return spec
     name = spec.split(":", 1)[1]
@@ -85,6 +89,7 @@ def build_model(spec):
     main_prog, startup = fluid.Program(), fluid.Program()
     main_prog.random_seed = startup.random_seed = 11
     scope = fluid.Scope()
+    decode_spec = lm_cfg = None
     with fluid.program_guard(main_prog, startup), fluid.scope_guard(scope):
         fluid.unique_name.switch()
         if name == "fc":
@@ -98,12 +103,38 @@ def build_model(spec):
                 src_vocab=32, trg_vocab=32, seq_len=6, emb_dim=8,
                 hid_dim=8, max_out_len=4)
             fetches, feeds = [ids, scores], ["src_ids", "src_len"]
+        elif name == "lm_decode":
+            from ..models import transformer as tlm
+
+            lm_cfg = tlm.lm_step_config(
+                vocab=29, d_model=16, d_ff=32, n_head=2, n_layer=2,
+                ctx_cap=32, pos_cap=64)
+            fetches, decode_spec = tlm.transformer_lm_step(**lm_cfg)
+            feeds = [decode_spec["token_feed"], decode_spec["pos_feed"]] \
+                + [c["feed"] for c in decode_spec["cache_feeds"]]
         else:
             raise SystemExit("unknown builtin model %r (have: fc, "
-                             "mt_greedy)" % name)
+                             "mt_greedy, lm_decode)" % name)
         exe = fluid.Executor(fluid.XLAPlace(0))
         exe.run(startup)
-    return ProgramPredictor(main_prog, feeds, fetches, scope=scope)
+    pred = ProgramPredictor(main_prog, feeds, fetches, scope=scope)
+    if decode_spec is not None:
+        # chunk sibling: same ParamAttr names -> same scope entries, so
+        # its startup is never run (the step startup initialized them)
+        from ..models import transformer as tlm
+
+        chunk_main, chunk_start = fluid.Program(), fluid.Program()
+        chunk_main.random_seed = chunk_start.random_seed = 11
+        with fluid.program_guard(chunk_main, chunk_start), \
+                fluid.scope_guard(scope):
+            fluid.unique_name.switch()
+            cfetch, cspec = tlm.transformer_lm_chunk(**lm_cfg)
+        cfeeds = [cspec["token_feed"], cspec["pos_feed"]] \
+            + [c["feed"] for c in cspec["cache_feeds"]]
+        cpred = ProgramPredictor(chunk_main, cfeeds, cfetch, scope=scope)
+        pred._decode_spec = decode_spec
+        pred._decode_prefill = {"predictor": cpred, "spec": cspec}
+    return pred
 
 
 class _WorkerState:
@@ -159,7 +190,10 @@ def _handle_infer(state, header, arrays):
             token = tracer.activate(ctx)
     try:
         with trace.span("worker.queue") as sp:
-            fut = state.engine.submit(dict(arrays), timeout_s=remaining)
+            fut = state.engine.submit(
+                dict(arrays), timeout_s=remaining,
+                max_new_tokens=header.get("max_new_tokens"),
+                eos_id=header.get("eos_id"))
             outs = fut.result(remaining + 30.0 if remaining is not None
                               else 300.0)
             if sp:
@@ -178,6 +212,8 @@ def _handle_infer(state, header, arrays):
             tracer.deactivate(token)
     with state.lock:
         state.served += 1
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]  # decode futures resolve to ONE ids array
     out_arrays = {"o%d" % i: np.asarray(o) for i, o in enumerate(outs)}
     return {"type": "result", "n_out": len(outs)}, out_arrays
 
@@ -323,11 +359,43 @@ def main(argv=None):
     from .engine import ServingEngine
 
     ladder = tuple(int(x) for x in args.ladder.split(",") if x.strip())
-    engine = ServingEngine(build_model(args.model),
+    model = build_model(args.model)
+    # decode mode: a builtin:lm_decode predictor carries its spec; a
+    # saved-model dir carrying decode_spec.json auto-serves decode.
+    # Knobs ride env vars so the router's worker_env reaches them
+    # without new CLI plumbing: PADDLE_TPU_PREFIX_CACHE_MB (>0 turns on
+    # the shared prefix-KV cache), PADDLE_TPU_DECODE_MAX_NEW (default
+    # max_new_tokens per request)
+    decode_kw = {}
+    decode_spec = getattr(model, "_decode_spec", None)
+    if decode_spec is not None:
+        decode_kw["decode"] = decode_spec
+        prefill = getattr(model, "_decode_prefill", None)
+        if prefill is not None:
+            decode_kw["decode_prefill"] = prefill
+    elif isinstance(model, str) and os.path.exists(
+            os.path.join(model, "decode_spec.json")):
+        decode_kw["decode"] = True
+    if decode_kw:
+        try:
+            mb = float(os.environ.get("PADDLE_TPU_PREFIX_CACHE_MB",
+                                      "0") or 0)
+        except ValueError:
+            mb = 0.0
+        if mb > 0:
+            decode_kw["prefix_cache"] = {
+                "max_bytes": int(mb * (1 << 20))}
+        try:
+            decode_kw["default_max_new_tokens"] = int(os.environ.get(
+                "PADDLE_TPU_DECODE_MAX_NEW", "16"))
+        except ValueError:
+            decode_kw["default_max_new_tokens"] = 16
+    engine = ServingEngine(model,
                            num_replicas=args.replicas, ladder=ladder,
                            max_wait_ms=args.max_wait_ms,
                            max_queue_depth=args.max_queue_depth,
-                           placement=args.placement, mp=args.mp)
+                           placement=args.placement, mp=args.mp,
+                           **decode_kw)
     if args.warmup:
         try:
             engine.warmup()

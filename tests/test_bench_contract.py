@@ -48,6 +48,8 @@ def test_serving_bench_record(monkeypatch):
     monkeypatch.setenv("BENCH_ROUTER_WORKERS", "1,2")
     monkeypatch.setenv("BENCH_ROUTER_REQUESTS", "8")
     monkeypatch.setenv("BENCH_ROUTER_RATES", "60")
+    monkeypatch.setenv("BENCH_PREFIX_REQUESTS", "6")
+    monkeypatch.setenv("BENCH_SPEC_REQUESTS", "4")
     rec = bench._bench_serving(on_tpu=False)
     assert rec["metric"] == "serving_requests_per_sec"
     assert rec["unit"] == "requests/sec"
@@ -91,6 +93,27 @@ def test_serving_bench_record(monkeypatch):
     assert dec["requests"] == 10
     assert dec["continuous_rps"] > 0 and dec["oneshot_rps"] > 0
     assert dec["speedup"] > 0 and dec["tokens_per_sec"] > 0
+    # ISSUE 20: the shared-prefix TTFT A/B — the CPU smoke must MEASURE
+    # a ratio > 1 (the TTFT-collapse acceptance), with the cache's own
+    # evidence riding the record
+    pab = rec["prefix_ab"]
+    assert pab["requests"] == 6 and pab["shared_prefix_len"] > 0
+    assert pab["prefix_hits"] > 0 and pab["prefix_tokens_reused"] > 0
+    assert pab["ttft_p50_nocache_s"] > 0 and pab["ttft_p50_cache_s"] > 0
+    assert pab["ttft_ratio"] is not None and pab["ttft_ratio"] > 1.0
+    assert "claim" in pab
+    # ISSUE 20: the speculative A/B — bitwise parity is enforced inside
+    # the bench itself; the CPU speedup is recorded as the honest
+    # negative result (the latency claim needs TPU dispatch costs)
+    sab = rec["spec_ab"]
+    assert sab["requests"] == 4 and sab["draft_k"] >= 2
+    assert sab["bitwise_parity"] is True
+    assert sab["plain_rps"] > 0 and sab["spec_rps"] > 0
+    assert sab["speedup"] is not None
+    assert sab["spec_accept_rate"] is None \
+        or 0.0 <= sab["spec_accept_rate"] <= 1.0
+    assert sab["decode_steps_spec"] < sab["decode_steps_plain"]
+    assert "negative result" in sab["claim"]
     # reliability counters ride along and are all ZERO in a healthy run —
     # a nonzero means the number was earned under degradation
     rel = rec["reliability"]

@@ -479,6 +479,36 @@ def test_decode_cache_verdict():
     assert bound3 == 4
 
 
+def test_decode_cache_verdict_prefill_ladder():
+    """ISSUE 20: the chunked-prefill extension — the bound grows to
+    (batch x ctx x (1 step + prefill rungs)), a prefill rung above the
+    spec's capacity is its OWN finding yet stays counted, and duplicate
+    prefill rungs dedup like the batcher dedups them."""
+    spec = {"ctx_cap": 32}
+    bound, res = resources.decode_cache_verdict(
+        spec, ladder=(1, 2, 4), ctx_ladder=(16, 32), budget=18,
+        prefill_ladder=(8, 16))
+    assert bound == 3 * 2 * 3 and res.ok and not res.diagnostics
+    # one prefill rung over ctx_cap + the budget breach: two findings,
+    # and the budget message names the chunk-rung decomposition
+    bound2, res2 = resources.decode_cache_verdict(
+        spec, ladder=(1, 2), ctx_ladder=(32,), budget=2,
+        prefill_ladder=(16, 64))
+    assert bound2 == 2 * 1 * 3
+    checks = [d.check for d in res2.diagnostics]
+    assert checks.count("compile-cache") == 2
+    assert any("prefill ladder rung 64" in d.message
+               for d in res2.diagnostics)
+    assert any("still counted in the bound" in d.message
+               for d in res2.diagnostics)
+    assert any("1 step + 2 chunk rungs" in d.message
+               for d in res2.diagnostics)
+    bound3, _ = resources.decode_cache_verdict(
+        spec, ladder=(1,), ctx_ladder=(16,), budget=64,
+        prefill_ladder=(8, 8, 16))
+    assert bound3 == 1 * 1 * 3
+
+
 def test_decode_batcher_compile_cache_bound():
     from paddle_tpu.serving.decode_batcher import DecodeBatcher
 
@@ -496,6 +526,29 @@ def test_decode_batcher_compile_cache_bound():
                         ctx_ladder=(16, 32), start=False)
     assert bat.compile_cache_bound() == 4
     assert bat.compiled_shape_counts()[0] <= bat.compile_cache_bound()
+
+    # with a chunk program riding along, the batcher's bound matches the
+    # verdict's (batch x ctx x (1 step + prefill rungs)) product
+    class _FakeChunkPred:
+        fetch_names = ["clogits", "k0c_out"]
+
+        def run(self, feed, return_numpy=False):
+            raise AssertionError("static test: no steps")
+
+    cspec = {"token_feed": "ctok", "pos_feed": "cpos",
+             "logits_fetch": "clogits", "ctx_cap": 32,
+             "cache_feeds": [{"feed": "k0", "fetch": "k0c_out",
+                              "tail": [4]}]}
+    bat2 = DecodeBatcher(_FakePred(), spec, ladder=(1, 2),
+                         ctx_ladder=(16, 32),
+                         prefill={"predictor": _FakeChunkPred(),
+                                  "spec": cspec, "ladder": (4, 8)},
+                         start=False)
+    assert bat2.compile_cache_bound() == 2 * 2 * 3
+    vbound, _ = resources.decode_cache_verdict(
+        spec, ladder=(1, 2), ctx_ladder=(16, 32), budget=64,
+        prefill_ladder=bat2.prefill_ladder)
+    assert vbound == bat2.compile_cache_bound()
 
 
 # ---------------------------------------------------------------------------

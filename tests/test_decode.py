@@ -213,3 +213,99 @@ def test_dynamic_rnn_freezes_at_length(rng):
                   fetch_list=[out])
     np.testing.assert_allclose(o[2, 0], o2[2, 0], rtol=1e-6)
     np.testing.assert_allclose(o[1, :3], o2[1, :3], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# prefix-KV cache (ISSUE 20): pure-python trie/LRU/refcount semantics
+# ---------------------------------------------------------------------------
+
+def _entry_rows(n_rows, fill=1.0):
+    return {"cache_k_0": np.full((n_rows, 4), fill, "float32")}
+
+
+def test_prefix_cache_donor_subtree_match():
+    from paddle_tpu.serving import PrefixCache
+
+    pc = PrefixCache(max_bytes=1 << 20)
+    key = (3, 7, 11, 2, 5)
+    pc.insert(key, _entry_rows(5))
+    # identical prompt, capped at len-1: the deeper entry donates
+    m = pc.lookup(key, limit=4)
+    assert m is not None and m.length == 4
+    assert m.entry.rows["cache_k_0"].shape[0] == 5
+    pc.release(m.entry)
+    # diverging prompt: match depth = shared prefix length
+    m2 = pc.lookup((3, 7, 11, 9, 9, 9), limit=5)
+    assert m2 is not None and m2.length == 3
+    pc.release(m2.entry)
+    # no shared prefix at all
+    assert pc.lookup((8, 8, 8), limit=2) is None
+    assert pc.stats()["hits"] == 2 and pc.stats()["misses"] == 1
+
+
+def test_prefix_cache_lru_eviction_skips_pinned():
+    from paddle_tpu.serving import PrefixCache
+
+    one = _entry_rows(4)["cache_k_0"].nbytes  # 64 bytes
+    pc = PrefixCache(max_bytes=2 * one)
+    pc.insert((1, 1, 1, 1), _entry_rows(4))
+    pc.insert((2, 2, 2, 2), _entry_rows(4))
+    # pin the LRU entry; the next insert must evict the OTHER one
+    m = pc.lookup((1, 1, 1, 1, 9), limit=4)
+    assert m is not None and m.length == 4
+    pc.insert((3, 3, 3, 3), _entry_rows(4))
+    assert pc.lookup((2, 2, 2, 2, 9), limit=4) is None   # evicted
+    m1b = pc.lookup((1, 1, 1, 1, 9), limit=4)            # pinned survivor
+    assert m1b is not None
+    # a pinned clone source stays intact even after ITS key is evicted
+    pc.release(m1b.entry)
+    pc.release(m.entry)
+    pc.insert((4, 4, 4, 4), _entry_rows(4))
+    assert pc.stats()["evictions"] >= 1
+    assert pc.stats()["bytes"] <= 2 * one
+
+
+def test_prefix_cache_oversized_refused_and_trie_pruned():
+    from paddle_tpu.serving import PrefixCache
+
+    pc = PrefixCache(max_bytes=32)
+    pc.insert((9, 9, 9, 9, 9, 9, 9, 9), _entry_rows(8))  # 128B > budget
+    assert len(pc) == 0 and pc.stats()["bytes"] == 0
+    small = {"cache_k_0": np.zeros((2, 4), "float32")}   # 32B fits
+    pc.insert((5, 6), small)
+    assert len(pc) == 1
+    pc.insert((7, 8), dict(small))                        # evicts (5, 6)
+    assert pc.lookup((5, 6, 1), limit=2) is None
+    # eviction pruned the (5, 6) branch: the trie root holds ONE branch
+    assert len(pc._root.children) == 1
+    # duplicate insert is a no-op, not double-accounting
+    before = pc.stats()["bytes"]
+    pc.insert((7, 8), dict(small))
+    assert pc.stats()["bytes"] == before and len(pc) == 1
+
+
+def test_chunk_cache_write_matches_stepwise_writes():
+    """kv_cache_write_chunk == K stepwise kv_cache_write calls, and the
+    pad sentinel (pos == cache capacity) drops: it writes nothing."""
+    cap, d = 8, 4
+    main, startup = fluid.Program(), fluid.Program()
+    with fluid.program_guard(main, startup):
+        cache = layers.data("cache", shape=[cap, d], dtype="float32")
+        rows = layers.data("rows", shape=[3, d], dtype="float32")
+        pos = layers.data("pos", shape=[3], dtype="int32")
+        out = layers.kv_cache_write_chunk(cache, rows, pos)
+        exe = fluid.Executor(fluid.CPUPlace())
+        exe.run(startup)
+        cache_np = np.zeros((2, cap, d), "float32")
+        rows_np = np.arange(2 * 3 * d, dtype="float32").reshape(2, 3, d)
+        # row 0 writes 1, 2, 3; row 1 writes 5 then two PAD lanes (pos
+        # == cap) that must vanish
+        pos_np = np.array([[1, 2, 3], [5, cap, cap]], "int32")
+        got, = exe.run(main, feed={"cache": cache_np, "rows": rows_np,
+                                   "pos": pos_np}, fetch_list=[out])
+    want = cache_np.copy()
+    for i in range(2):
+        for j in range(3):
+            if pos_np[i, j] < cap:
+                want[i, pos_np[i, j]] = rows_np[i, j]
+    np.testing.assert_array_equal(got, want)

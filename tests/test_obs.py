@@ -301,7 +301,12 @@ def test_registry_snapshot_consistency():
     m.observe_deadline_refused()
     m.observe_batch(actual=3, bucket=4, cache_hit=True)
     m.observe_decode_step(live=2, bucket=4, generated=1)
+    m.observe_prefix_hit(5)
+    m.observe_prefix_eviction()
+    m.observe_prefill_chunk(2, 9)
+    m.observe_spec(accepted=3, rejected=1)
     m.bind_gauges(lambda: 7, lambda: 1)
+    m.bind_prefix_bytes(lambda: 4096)
     snap = m.snapshot()
     vals = m.registry.values()
     for field in ("requests_completed", "requests_failed",
@@ -311,12 +316,16 @@ def test_registry_snapshot_consistency():
                   "heartbeat_misses", "deadline_refused", "batches",
                   "compile_cache_hits", "compile_cache_misses",
                   "decode_steps", "decode_tokens", "queue_depth",
-                  "in_flight"):
+                  "in_flight", "prefix_hits", "prefix_tokens_reused",
+                  "prefix_evictions", "prefix_bytes", "prefill_chunks",
+                  "prefill_tokens", "spec_accepted", "spec_rejected"):
         assert vals["paddle_tpu_serving_" + field] == snap[field], field
     # derived fields still derive from registry counters
     assert snap["batch_occupancy"] == 3 / 4
     assert snap["slot_occupancy"] == 2 / 4
     assert snap["compile_cache_hit_rate"] == 1.0
+    assert snap["spec_accept_rate"] == 3 / 4
+    assert snap["prefix_bytes"] == 4096
     # the pinned snapshot field list itself is unchanged (the contract
     # test_bench_contract.py leans on)
     assert set(snap) == {
@@ -327,7 +336,10 @@ def test_registry_snapshot_consistency():
         "in_flight", "batches", "batch_occupancy", "avg_batch_size",
         "compile_cache_hits", "compile_cache_misses",
         "compile_cache_hit_rate", "decode_steps", "decode_tokens",
-        "slot_occupancy", "latency_s", "ttft_s", "tpot_s"}
+        "slot_occupancy", "latency_s", "ttft_s", "tpot_s",
+        "prefix_hits", "prefix_tokens_reused", "prefix_evictions",
+        "prefix_bytes", "prefill_chunks", "prefill_tokens",
+        "spec_accepted", "spec_rejected", "spec_accept_rate"}
 
 
 # -- MFU gauge vs the static cost model -------------------------------------

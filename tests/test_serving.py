@@ -1087,7 +1087,8 @@ def test_decode_step_program_verifies_clean():
     from paddle_tpu.analysis.cli import _zoo_builders, analyze_zoo_model
 
     builders = _zoo_builders()
-    for name in ("transformer.lm", "transformer.lm_step"):
+    for name in ("transformer.lm", "transformer.lm_step",
+                 "transformer.lm_chunk"):
         main_res, startup_res = analyze_zoo_model(builders[name])
         assert not main_res.diagnostics, (name, main_res.diagnostics)
         assert not startup_res.diagnostics, (name, startup_res.diagnostics)
@@ -1122,3 +1123,180 @@ def test_decode_engine_from_saved_dir(tmp_path):
         np.testing.assert_array_equal(got, want)
     finally:
         eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: prefix cache, chunked prefill, speculative decode
+# ---------------------------------------------------------------------------
+
+def _build_lm_family(scope, ctx_cap=32, seed=3):
+    """:func:`_build_lm_pair` plus the chunk sibling and a ``DraftLM``
+    over the full program — the whole weight-sharing family on ONE
+    scope (only the full startup ever runs)."""
+    import paddle_tpu as fluid
+    from paddle_tpu import models
+    from paddle_tpu.inference import ProgramPredictor
+    from paddle_tpu.serving import DraftLM
+
+    pred, dspec, spec, full_main = _build_lm_pair(scope, ctx_cap=ctx_cap,
+                                                  seed=seed)
+    cfg = models.transformer.lm_step_config(
+        vocab=29, d_model=16, d_ff=32, n_head=2, n_layer=2,
+        ctx_cap=ctx_cap, pos_cap=64)
+    chunk_main, chunk_start = fluid.Program(), fluid.Program()
+    with fluid.program_guard(chunk_main, chunk_start), \
+            fluid.scope_guard(scope):
+        fluid.unique_name.switch()
+        cfetch, cspec = models.transformer.transformer_lm_chunk(**cfg)
+    cfeeds = [cspec["token_feed"], cspec["pos_feed"]] \
+        + [c["feed"] for c in cspec["cache_feeds"]]
+    cpred = ProgramPredictor(chunk_main, cfeeds, cfetch, scope=scope)
+    fpred = ProgramPredictor(full_main, ["ids", "lbl"],
+                             [spec.extras["logits"]], scope=scope)
+    draft = DraftLM(fpred, fpred.fetch_names[0], seq_len=8)
+    return pred, dspec, {"predictor": cpred, "spec": cspec}, draft
+
+
+def _drive_all(bat, reqs):
+    futs = [bat.submit(p, max_new_tokens=mn) for p, mn in reqs]
+    bat.drive()
+    return [tuple(int(t) for t in np.asarray(f.result(0)).ravel())
+            for f in futs]
+
+
+def test_chunked_prefill_bitwise_vs_step_only():
+    """Chunked prefill is a latency optimization, not a math change: the
+    same mixed workload through a chunk-equipped batcher returns
+    bitwise-identical tokens, in fewer decode steps, with chunk
+    dispatches actually recorded."""
+    import paddle_tpu as fluid
+
+    scope = fluid.Scope()
+    pred, dspec, prefill, _draft = _build_lm_family(scope)
+    reqs = [([3, 7, 11, 2, 5, 9, 4, 6, 1, 8, 2, 3], 6),
+            ([1, 2], 4), ([5], 3), ([8, 9, 10, 11, 12, 13], 5)]
+
+    plain = DecodeBatcher(pred, dspec, ladder=(4,), ctx_ladder=(32,),
+                          start=False)
+    want = _drive_all(plain, reqs)
+    chunked = DecodeBatcher(pred, dspec, ladder=(4,), ctx_ladder=(32,),
+                            prefill=prefill, start=False)
+    got = _drive_all(chunked, reqs)
+    assert got == want
+    mp, mc = plain.metrics(), chunked.metrics()
+    assert mc["prefill_chunks"] > 0 and mc["prefill_tokens"] > 0
+    assert mc["decode_steps"] < mp["decode_steps"]
+
+
+def test_speculative_bitwise_parity_greedy():
+    """THE speculative guarantee: greedy accept makes the output
+    bitwise-identical to plain decode for ANY draft quality — the good
+    draft (the weight-sharing full program) and an adversarial garbage
+    draft, including a request admitted into a recycled dirty slot."""
+    import paddle_tpu as fluid
+
+    scope = fluid.Scope()
+    pred, dspec, prefill, draft = _build_lm_family(scope)
+    prompt = [3, 7, 11]
+
+    solo_b = DecodeBatcher(pred, dspec, ladder=(4,), ctx_ladder=(32,),
+                           start=False)
+    f = solo_b.submit(prompt, max_new_tokens=8)
+    solo_b.drive()
+    solo = tuple(int(t) for t in np.asarray(f.result(0)).ravel())
+
+    class GarbageDraft:
+        def propose(self, histories, n):
+            return [[1] * n for _ in histories]
+
+    for d in (draft, GarbageDraft()):
+        bat = DecodeBatcher(pred, dspec, ladder=(4,), ctx_ladder=(32,),
+                            prefill=prefill,
+                            speculative={"draft": d, "k": 4}, start=False)
+        futs = [bat.submit(prompt, max_new_tokens=8),
+                bat.submit([1, 2], max_new_tokens=9),
+                bat.submit([5], max_new_tokens=3)]
+        bat.drive()
+        got = tuple(int(t)
+                    for t in np.asarray(futs[0].result(0)).ravel())
+        assert got == solo, type(d).__name__
+        # recycled dirty slot: after the first wave retires, the same
+        # prompt admitted into a reused slot must still match solo
+        rec = bat.submit(prompt, max_new_tokens=8)
+        bat.drive()
+        rec_got = tuple(int(t)
+                        for t in np.asarray(rec.result(0)).ravel())
+        assert rec_got == solo, type(d).__name__
+    m = bat.metrics()
+    assert m["spec_accepted"] + m["spec_rejected"] > 0
+
+
+def test_prefix_cache_eviction_refcount_no_corruption():
+    """Prefix-cache hits, LRU eviction under a starvation-level byte
+    budget, and refcount pinning never change decoded tokens: every
+    request through a churning cache matches the cache-less reference
+    bitwise (clone-never-alias means an evicted donor cannot reach into
+    a live slot's rows)."""
+    import paddle_tpu as fluid
+
+    scope = fluid.Scope()
+    pred, dspec, _prefill, _draft = _build_lm_family(scope)
+    shared = [3, 7, 11, 2, 5, 9, 4, 6]
+    prompts = [shared + [t] for t in (1, 8, 13, 17, 20, 22)]
+    reqs = [(p, 4) for p in prompts for _ in (0, 1)]
+
+    def run_sequential(bat):
+        # drive each request to completion before the next submit, so
+        # every lookup sees the previous harvests (and the churn is
+        # insert -> evict -> insert, not one cold batch)
+        out = []
+        for p, mn in reqs:
+            f = bat.submit(p, max_new_tokens=mn)
+            bat.drive()
+            out.append(tuple(int(t)
+                             for t in np.asarray(f.result(0)).ravel()))
+        return out
+
+    plain = DecodeBatcher(pred, dspec, ladder=(2,), ctx_ladder=(16,),
+                          start=False)
+    want = run_sequential(plain)
+
+    # budget sized to hold ~2 harvested prompts: constant churn
+    one_entry = 4 * (len(shared) + 1) * 16 * 4  # feeds*rows*d_model*f32
+    cached = DecodeBatcher(pred, dspec, ladder=(2,), ctx_ladder=(16,),
+                           prefix_cache={"max_bytes": 2 * one_entry},
+                           start=False)
+    got = run_sequential(cached)
+    assert got == want
+    m = cached.metrics()
+    assert m["prefix_hits"] > 0 and m["prefix_evictions"] > 0
+    assert cached.prefix_cache.nbytes <= 2 * one_entry
+
+
+def test_decode_compile_cache_soak_within_bound():
+    """ISSUE 20 acceptance: with prefill + speculative live, a mixed
+    soak never compiles past the verdict's (batch x ctx x prefill-rung)
+    bound, and the batcher's own bound agrees with the verdict."""
+    import paddle_tpu as fluid
+    from paddle_tpu.analysis import resources
+
+    scope = fluid.Scope()
+    pred, dspec, prefill, draft = _build_lm_family(scope)
+    bat = DecodeBatcher(pred, dspec, ladder=(1, 2, 4), ctx_ladder=(16, 32),
+                        prefill=prefill, prefix_cache=True,
+                        speculative={"draft": draft, "k": 3}, start=False)
+    vbound, res = resources.decode_cache_verdict(
+        dspec, ladder=(1, 2, 4), ctx_ladder=(16, 32), budget=64,
+        prefill_ladder=bat.prefill_ladder)
+    assert res.ok and bat.compile_cache_bound() == vbound
+    rng = np.random.RandomState(7)
+    for wave in range(4):
+        n = int(rng.randint(1, 5))
+        futs = [bat.submit(list(rng.randint(1, 29,
+                                            size=rng.randint(1, 12))),
+                           max_new_tokens=int(rng.randint(1, 8)))
+                for _ in range(n)]
+        bat.drive()
+        assert all(f.done() for f in futs)
+    assert len(bat.seen_signatures) <= vbound
+    assert all(c <= vbound for c in bat.compiled_shape_counts())

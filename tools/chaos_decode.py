@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Chaos drill for the continuous-batching decode door (ISSUE 20).
+
+Stands up a router + N ``builtin:lm_decode`` workers with the prefix-KV
+cache ENABLED, drives shared-prefix decode requests until the cache is
+hot, SIGKILLs a worker mid-decode, and audits three invariants:
+
+  1. **Zero silent losses** — every accepted request resolves to tokens
+     or a TYPED error (``WorkerFailed`` et al.) within its bound.
+  2. **No corruption from a hot cache** — greedy decode is deterministic
+     and every worker seeds identically, so every completed burst reply
+     must be bitwise-identical to the cold-pass reply for its prompt.
+     A mismatch means a cloned prefix row leaked stale state.
+  3. **No stale prefix after respawn** — after the fleet heals, the same
+     prompts must reproduce the cold-pass outputs exactly. The respawned
+     worker starts with an empty cache; if its answers drift, the cache
+     was load-bearing for correctness (it must only be load-bearing for
+     latency).
+
+    python tools/chaos_decode.py --workers 2 --requests 16 --kill
+    python tools/chaos_decode.py --smoke    # lint.sh gate: 2 workers,
+                                            # 6 requests, WITH kill
+
+Prints one JSON summary line (counters + verdict) so CI logs stay
+greppable. The drill also scrapes each worker's Prometheus exposition
+and requires ``prefix_hits > 0`` — proof the drill actually exercised
+the cache rather than vacuously passing with it cold.
+"""
+
+import argparse
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _prompts(n_distinct):
+    """Shared-prefix prompt family inside the builtin vocab (29)."""
+    base = [5, 7, 11, 13, 2, 3, 17, 19]
+    return [base + [21 + (i % 7), 1 + i % 28] for i in range(n_distinct)]
+
+
+def _scrape_prefix_hits(router):
+    """Sum ``paddle_tpu_serving_prefix_hits`` across live workers via
+    the worker 'stats' verb (the router only relays ping gauges)."""
+    from paddle_tpu.serving import rpc
+
+    total = 0.0
+    for w in list(router._workers):
+        try:
+            sock = rpc.connect(w.address, timeout=5.0)
+            try:
+                rpc.send_msg(sock, {"type": "stats"}, None)
+                header, _ = rpc.recv_msg(sock)
+            finally:
+                sock.close()
+        except Exception:
+            continue  # a freshly killed worker is fine to skip
+        for line in header.get("prometheus", "").splitlines():
+            if line.startswith("paddle_tpu_serving_prefix_hits "):
+                total += float(line.split()[-1])
+    return total
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="chaos_decode", description=__doc__.splitlines()[0])
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--max-new", type=int, default=6)
+    ap.add_argument("--kill", action="store_true",
+                    help="SIGKILL one worker while the decode burst is "
+                         "in flight, then require a respawn")
+    ap.add_argument("--timeout-s", type=float, default=90.0)
+    ap.add_argument("--smoke", action="store_true",
+                    help="CI preset: 2 workers, 6 requests, WITH kill — "
+                         "the drill's whole point is the mid-decode kill")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        args.workers, args.requests, args.kill = 2, 6, True
+        args.max_new = min(args.max_new, 4)
+
+    import numpy as np
+
+    from paddle_tpu.serving import (DeadlineExceededError, Router,
+                                    RouterClient, RouterShutdownError,
+                                    ServerOverloadedError,
+                                    WorkerFailedError)
+
+    worker_env = {
+        "PADDLE_TPU_PREFIX_CACHE_MB": "8",
+        "PADDLE_TPU_DECODE_MAX_NEW": str(args.max_new),
+    }
+    router = Router("builtin:lm_decode", num_workers=args.workers,
+                    heartbeat_interval_s=0.2, worker_env=worker_env)
+    prompts = _prompts(4)
+    summary = {"workers": args.workers, "requests": args.requests,
+               "kill": bool(args.kill), "accepted": 0, "completed": 0,
+               "typed_errors": {}, "silent_losses": 0, "respawns": 0,
+               "recovered": None, "prefix_hits": 0.0,
+               "hot_match": None, "burst_mismatches": 0,
+               "post_respawn_match": None}
+    typed = (WorkerFailedError, ServerOverloadedError,
+             DeadlineExceededError, RouterShutdownError)
+
+    def ask(p):
+        out = client.predict({"prompt_ids": np.asarray(p, "int64")},
+                             timeout_s=args.timeout_s,
+                             max_new_tokens=args.max_new)
+        return tuple(int(t) for t in np.asarray(out[0]).ravel())
+
+    try:
+        router.start()
+        client = RouterClient(router.address, pool_size=8)
+        # T1 — cold pass: harvests every prompt's prefix into the cache
+        # and records the ground-truth greedy output per prompt
+        truth = {tuple(p): ask(p) for p in prompts}
+        # T1b — hot pass: same prompts, now admitted via prefix clones;
+        # outputs must not move (a drifted clone = stale/corrupt rows)
+        summary["hot_match"] = all(
+            ask(p) == truth[tuple(p)] for p in prompts)
+        summary["prefix_hits"] = _scrape_prefix_hits(router)
+        # burst + mid-decode kill, cache hot on every worker
+        futs = [(prompts[i % len(prompts)],
+                 client.submit({"prompt_ids": np.asarray(
+                     prompts[i % len(prompts)], "int64")},
+                     timeout_s=args.timeout_s,
+                     max_new_tokens=args.max_new))
+                for i in range(args.requests)]
+        summary["accepted"] = len(futs)
+        if args.kill:
+            os.kill(router._workers[0].pid, signal.SIGKILL)
+        for p, f in futs:
+            try:
+                out = f.result(args.timeout_s + 30.0)
+                summary["completed"] += 1
+                got = tuple(int(t) for t in np.asarray(out[0]).ravel())
+                if got != truth[tuple(p)]:
+                    summary["burst_mismatches"] += 1
+            except typed as e:
+                kind = type(e).__name__
+                summary["typed_errors"][kind] = \
+                    summary["typed_errors"].get(kind, 0) + 1
+            except Exception:
+                summary["silent_losses"] += 1
+        if args.kill:
+            t0 = time.time()
+            while time.time() - t0 < 60.0:
+                snap = router.metrics_.snapshot()
+                if snap["respawns"] >= 1 and all(
+                        w["healthy"] for w in router._worker_states()):
+                    break
+                time.sleep(0.2)
+            summary["recovered"] = True
+        summary["respawns"] = router.metrics_.snapshot()["respawns"]
+        # T2 — post-respawn pass: the healed fleet (one cold cache, one
+        # hot) must still reproduce the cold-pass outputs exactly
+        try:
+            summary["post_respawn_match"] = all(
+                ask(p) == truth[tuple(p)] for p in prompts)
+        except Exception:
+            summary["post_respawn_match"] = False
+            summary["recovered"] = False
+        client.close()
+    finally:
+        router.shutdown()
+
+    ok = (summary["silent_losses"] == 0
+          and summary["completed"] > 0
+          and summary["hot_match"] is True
+          and summary["burst_mismatches"] == 0
+          and summary["post_respawn_match"] is True
+          and summary["prefix_hits"] > 0
+          and (not args.kill or summary["respawns"] >= 1))
+    summary["verdict"] = "ok" if ok else "FAIL"
+    print(json.dumps(summary))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -29,6 +29,11 @@
 #      mid-file death resumed exactly-once from its cursor, a lease
 #      takeover past the TTL, and a two-phase fleet swap that
 #      quarantines (then heals) a commit-faulted straggler.
+#   7. the decode chaos smoke (`tools/chaos_decode.py --smoke`, ISSUE
+#      20): two lm-decode workers with the prefix-KV cache hot, a
+#      mid-decode SIGKILL — zero silent losses, every completed reply
+#      bitwise-equal to the cold pass, and no stale prefix after the
+#      respawn.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -53,5 +58,7 @@ JAX_PLATFORMS=cpu "$PY" tools/trace_view.py --smoke
 JAX_PLATFORMS=cpu "$PY" tools/chaos_stream.py --smoke
 
 JAX_PLATFORMS=cpu "$PY" tools/chaos_fleet.py --smoke
+
+JAX_PLATFORMS=cpu "$PY" tools/chaos_decode.py --smoke
 
 echo "lint.sh: ok"
